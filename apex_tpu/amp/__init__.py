@@ -1,0 +1,38 @@
+"""``apex_tpu.amp`` — functional automatic mixed precision.
+
+API-parity facade over :mod:`apex_tpu.core` for users coming from
+``apex.amp`` (reference: ``apex/amp/frontend.py``, ``handle.py``).  The
+reference patches the torch namespace process-wide; here ``initialize``
+returns an explicit :class:`PrecisionPolicy` +
+:class:`MixedPrecisionTrainState` and ``scale_loss`` is a pure function.
+"""
+
+from apex_tpu.amp.frontend import (
+    initialize,
+    scale_loss,
+    master_params,
+    state_dict,
+    load_state_dict,
+)
+from apex_tpu.amp import o1
+from apex_tpu.amp.lists import (
+    HALF_FUNCS,
+    FP32_FUNCS,
+    PROMOTE_FUNCS,
+    classify_op,
+)
+from apex_tpu.core.precision import PrecisionPolicy
+
+__all__ = [
+    "initialize",
+    "scale_loss",
+    "master_params",
+    "state_dict",
+    "load_state_dict",
+    "PrecisionPolicy",
+    "HALF_FUNCS",
+    "FP32_FUNCS",
+    "PROMOTE_FUNCS",
+    "classify_op",
+    "o1",
+]

@@ -1,0 +1,82 @@
+"""``amp.initialize`` / ``amp.scale_loss`` parity layer.
+
+Reference: ``apex/amp/frontend.py`` (opt-level resolution + kwargs
+overrides), ``apex/amp/handle.py`` (``scale_loss`` context manager),
+``apex/amp/amp.py`` (``master_params``), ``_amp_state`` (``state_dict``).
+The functional translation: no global ``_amp_state``; everything lives in
+the returned :class:`MixedPrecisionTrainState`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import optax
+
+from apex_tpu.core.precision import PrecisionPolicy
+from apex_tpu.core.train_state import MixedPrecisionTrainState
+
+__all__ = [
+    "initialize", "scale_loss", "master_params", "state_dict",
+    "load_state_dict",
+]
+
+
+def initialize(
+    apply_fn: Callable,
+    params: Any,
+    tx: optax.GradientTransformation,
+    opt_level: str = "O1",
+    *,
+    half_dtype: Any = None,
+    loss_scale: Any = "__unset__",
+    keep_batchnorm_fp32: Any = "__unset__",
+    master_weights: Any = "__unset__",
+    **policy_overrides: Any,
+) -> MixedPrecisionTrainState:
+    """Build a mixed-precision train state from an opt level.
+
+    Functional analogue of ``amp.initialize(model, optimizer,
+    opt_level=..., loss_scale=..., keep_batchnorm_fp32=...,
+    master_weights=...)`` — same override knobs, but returns a new pytree
+    instead of mutating the inputs.
+    """
+    import jax.numpy as jnp
+
+    overrides = dict(policy_overrides)
+    if loss_scale != "__unset__":
+        overrides["loss_scale"] = loss_scale
+    if keep_batchnorm_fp32 != "__unset__":
+        overrides["keep_batchnorm_fp32"] = keep_batchnorm_fp32
+    if master_weights != "__unset__":
+        overrides["master_weights"] = master_weights
+    kw = {"half_dtype": half_dtype} if half_dtype is not None else {}
+    policy = PrecisionPolicy.from_opt_level(opt_level, **kw, **overrides)
+    return MixedPrecisionTrainState.create(
+        apply_fn=apply_fn, params=params, tx=tx, policy=policy)
+
+
+def scale_loss(loss: Any, state: MixedPrecisionTrainState) -> Any:
+    """Pure-function form of ``with amp.scale_loss(loss, optimizer)``.
+
+    Use inside the loss function so the gradient is of the scaled loss;
+    :meth:`MixedPrecisionTrainState.apply_gradients` unscales.
+    """
+    return state.scale_loss(loss)
+
+
+def master_params(state: MixedPrecisionTrainState) -> Any:
+    """fp32 master parameters (``amp.master_params(optimizer)``)."""
+    return state.policy.master_params(state.params)
+
+
+def state_dict(state: MixedPrecisionTrainState) -> dict:
+    """Loss-scaler persistence (``amp.state_dict()``)."""
+    return state.amp_state_dict()
+
+
+def load_state_dict(
+    state: MixedPrecisionTrainState, d: dict
+) -> MixedPrecisionTrainState:
+    """``amp.load_state_dict()`` — returns an updated state pytree."""
+    return state.load_amp_state_dict(d)

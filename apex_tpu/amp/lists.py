@@ -1,0 +1,61 @@
+"""O1 per-op cast classification (reference: ``apex/amp/lists/``).
+
+The reference keeps three lists — ``FP16_FUNCS`` (tensor-core-friendly ops
+run in half), ``FP32_FUNCS`` (numerically sensitive ops run in fp32) and
+promote/cast lists (multi-arg ops promote to the widest input dtype) — in
+``apex/amp/lists/{functional_overrides,torch_overrides,tensor_overrides}.py``
+and uses them to monkey-patch the torch namespace.
+
+Here the classification is *data*, consumed by :mod:`apex_tpu.amp.o1`'s
+``cast_op`` wrapper and flax interceptor, which cast explicitly instead
+of patching.
+Names are JAX-centric; the mapping from the reference's torch names is
+noted inline.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+__all__ = ["HALF_FUNCS", "FP32_FUNCS", "PROMOTE_FUNCS", "classify_op"]
+
+# MXU-friendly ops: run in half precision under O1.
+# (reference FP16_FUNCS: conv1d/2d/3d, conv_transpose*, linear, matmul,
+#  mm, bmm, addmm, prelu, …)
+HALF_FUNCS = frozenset({
+    "dot", "dot_general", "matmul", "einsum", "linear", "dense",
+    "conv", "conv_general_dilated", "conv_transpose",
+    "attention", "scaled_dot_product_attention",
+})
+
+# Numerically sensitive ops: always fp32 under O1.
+# (reference FP32_FUNCS: softmax/log_softmax, norms, loss functions,
+#  exp/log/pow/sum-reductions, cumsum, prod, …)
+FP32_FUNCS = frozenset({
+    "softmax", "log_softmax", "layer_norm", "rms_norm", "batch_norm",
+    "group_norm", "instance_norm", "cross_entropy", "nll_loss",
+    "mse_loss", "l1_loss", "cosine_similarity", "erf", "erfinv",
+    "exp", "expm1", "log", "log1p", "log2", "log10", "pow",
+    "sum", "mean", "cumsum", "cumprod", "prod", "var", "std",
+    "norm", "renorm", "dist", "logsumexp", "softplus", "gelu_fp32",
+})
+
+# Multi-arg ops that promote to the widest floating dtype of their inputs.
+# (reference casts.py 'promote' list: add, sub, mul, div, addcmul, cat, …)
+PROMOTE_FUNCS = frozenset({
+    "add", "sub", "mul", "div", "addcdiv", "addcmul", "atan2",
+    "bilinear", "cat", "concatenate", "cross", "dot_1d", "equal",
+    "stack", "tensordot", "where",
+})
+
+
+def classify_op(name: str) -> Literal["half", "fp32", "promote", "passthrough"]:
+    """Classify an op name for O1 casting, defaulting to passthrough
+    (reference: ops absent from every list keep their input dtype)."""
+    if name in HALF_FUNCS:
+        return "half"
+    if name in FP32_FUNCS:
+        return "fp32"
+    if name in PROMOTE_FUNCS:
+        return "promote"
+    return "passthrough"

@@ -1,0 +1,110 @@
+"""O1 per-op casting — the interceptor that consumes :mod:`apex_tpu.amp.lists`.
+
+The reference implements O1 by monkey-patching every function in
+``apex/amp/lists/*`` on the torch namespace (``apex/amp/amp.py::init`` +
+``wrap.py``) — a process-wide mutation.  The functional equivalent here
+has two entry points:
+
+- :func:`cast_op` — explicit wrapper for a single op call: casts inputs
+  per the op's classification ("half" / "fp32" / "promote"), runs the op,
+  and (for fp32 ops) returns the fp32 result exactly as the reference's
+  wrappers do.
+- :func:`o1_intercept` — a `flax.linen` interceptor
+  (``nn.intercept_methods``) that applies the same classification to
+  whole submodule calls, keyed on module class names (Dense/Conv →
+  half; LayerNorm/BatchNorm/Softmax/losses → fp32).  This is the
+  scoped, explicit analogue of patching: it applies only inside the
+  context manager, only to the wrapped model.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.lists import classify_op
+from apex_tpu.core.precision import tree_cast as _cast_tree
+
+__all__ = ["cast_op", "o1_intercept", "classify_module"]
+
+
+def _widest_float(tree: Any):
+    dtypes = [x.dtype for x in jax.tree.leaves(tree)
+              if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
+    if not dtypes:
+        return None
+    return jnp.result_type(*dtypes)
+
+
+def cast_op(name: str, fn: Callable, *args: Any,
+            half_dtype=jnp.bfloat16, **kwargs: Any) -> Any:
+    """Run ``fn(*args, **kwargs)`` with O1 input casting for op ``name``.
+
+    ``classify_op`` decides: "half" ops get half inputs (MXU path),
+    "fp32" ops get fp32 inputs and keep fp32 outputs, "promote" ops get
+    all-floating inputs promoted to the widest present dtype.
+    """
+    kind = classify_op(name)
+    if kind == "half":
+        args = _cast_tree(args, half_dtype)
+        kwargs = _cast_tree(kwargs, half_dtype)
+    elif kind == "fp32":
+        args = _cast_tree(args, jnp.float32)
+        kwargs = _cast_tree(kwargs, jnp.float32)
+    elif kind == "promote":
+        widest = _widest_float((args, kwargs))
+        if widest is not None:
+            args = _cast_tree(args, widest)
+            kwargs = _cast_tree(kwargs, widest)
+    return fn(*args, **kwargs)
+
+
+# Module-class-name → op-classification, the flax-module-level analogue
+# of the reference's torch_overrides/functional_overrides lists.
+_HALF_MODULES = ("dense", "conv", "linear", "einsum", "attention",
+                 "densegeneral", "mlp")
+_FP32_MODULES = ("layernorm", "batchnorm", "groupnorm", "rmsnorm",
+                 "norm", "softmax", "crossentropy", "loss", "embed")
+
+
+def classify_module(cls_name: str) -> str:
+    low = cls_name.lower()
+    for frag in _FP32_MODULES:
+        if frag in low:
+            return "fp32"
+    for frag in _HALF_MODULES:
+        if frag in low:
+            return "half"
+    return "passthrough"
+
+
+@contextlib.contextmanager
+def o1_intercept(half_dtype=jnp.bfloat16):
+    """Context manager applying O1 per-op casting to flax module calls.
+
+    Usage::
+
+        with amp.o1.o1_intercept(jnp.bfloat16):
+            out = model.apply(variables, x)
+
+    Scoped and explicit — the TPU-native replacement for
+    ``amp.initialize``'s torch-namespace patching (O1 path,
+    ``apex/amp/_initialize.py`` step 3).
+    """
+    import flax.linen as nn
+
+    def interceptor(next_fn, args, kwargs, context):
+        kind = classify_module(type(context.module).__name__)
+        if kind == "half":
+            args = _cast_tree(args, half_dtype)
+            kwargs = _cast_tree(kwargs, half_dtype)
+        elif kind == "fp32":
+            args = _cast_tree(args, jnp.float32)
+            kwargs = _cast_tree(kwargs, jnp.float32)
+        return next_fn(*args, **kwargs)
+
+    with nn.intercept_methods(interceptor):
+        yield

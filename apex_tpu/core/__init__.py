@@ -1,0 +1,43 @@
+"""Core numerics: precision policy, loss scaling, train state, mesh.
+
+TPU-native replacement for ``apex.amp`` / ``apex.fp16_utils`` (reference:
+``apex/amp/frontend.py``, ``apex/amp/scaler.py``,
+``apex/fp16_utils/fp16_optimizer.py``) — explicit functional policies
+instead of torch-namespace monkey-patching.
+"""
+
+from apex_tpu.core.precision import (
+    PrecisionPolicy,
+    cast_floating,
+    tree_cast,
+)
+from apex_tpu.core.loss_scale import (
+    LossScaleState,
+    DynamicLossScale,
+    StaticLossScale,
+    NoOpLossScale,
+    all_finite,
+)
+from apex_tpu.core.mesh import (
+    MeshConfig,
+    initialize_mesh,
+    get_mesh,
+    destroy_mesh,
+)
+from apex_tpu.core.train_state import MixedPrecisionTrainState
+
+__all__ = [
+    "PrecisionPolicy",
+    "cast_floating",
+    "tree_cast",
+    "LossScaleState",
+    "DynamicLossScale",
+    "StaticLossScale",
+    "NoOpLossScale",
+    "all_finite",
+    "MeshConfig",
+    "initialize_mesh",
+    "get_mesh",
+    "destroy_mesh",
+    "MixedPrecisionTrainState",
+]

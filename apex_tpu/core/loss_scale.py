@@ -1,0 +1,179 @@
+"""Static & dynamic loss scaling (reference: ``apex/amp/scaler.py``).
+
+Apex's ``LossScaler`` multiplies the loss by ``loss_scale`` before
+backward, unscales gradients with one fused ``amp_C.multi_tensor_scale``
+launch that also writes a device-side ``overflow_buf``, and on overflow
+skips the step and halves the scale; after 2000 consecutive clean steps it
+doubles the scale.
+
+Here the same state machine is a pure function over a
+:class:`LossScaleState` pytree.  The overflow flag is a device-side
+``bool`` array — it never forces a host sync, exactly like apex's
+``overflow_buf`` — and the whole scale/unscale/check/adjust sequence fuses
+into the surrounding jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.tree import tree_scale, tree_select
+
+__all__ = [
+    "LossScaleState",
+    "DynamicLossScale",
+    "StaticLossScale",
+    "NoOpLossScale",
+    "all_finite",
+]
+
+
+class LossScaleState(NamedTuple):
+    """Device-resident loss-scaler state (a pytree).
+
+    ``loss_scale`` — current scale (f32 scalar array).
+    ``growth_tracker`` — consecutive overflow-free steps (i32 scalar),
+    apex's ``unskipped`` counter.
+    """
+
+    loss_scale: jnp.ndarray
+    growth_tracker: jnp.ndarray
+
+    def state_dict(self) -> dict:
+        """Serializable form (parity: ``amp.state_dict()`` saves scaler state)."""
+        return {
+            "loss_scale": jax.device_get(self.loss_scale).item(),
+            "unskipped": jax.device_get(self.growth_tracker).item(),
+        }
+
+    @classmethod
+    def from_state_dict(cls, d: dict) -> "LossScaleState":
+        return cls(
+            loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+            growth_tracker=jnp.asarray(d["unskipped"], jnp.int32),
+        )
+
+
+def all_finite(tree: Any) -> jnp.ndarray:
+    """Device-side global finiteness flag over a pytree of arrays.
+
+    The jitted equivalent of apex's fused inf/nan check
+    (``amp_C.multi_tensor_scale``'s ``overflow_buf``): one fused reduction
+    over every leaf, no host sync.
+    """
+    leaves = [l for l in jax.tree.leaves(tree)
+              if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)]
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(l)) for l in leaves]
+    return jnp.stack(finite).all()
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicLossScale:
+    """Dynamic loss scaling manager (apex defaults: 2**16 init, x2/÷2, 2000).
+
+    Usage (all inside jit)::
+
+        ls = policy.make_loss_scale()
+        state = ls.init()
+        scaled_loss = ls.scale(state, loss)        # before grad
+        grads = ls.unscale(state, scaled_grads)    # one fused pytree op
+        finite = all_finite(grads)
+        state = ls.adjust(state, finite)           # skip step when ~finite
+    """
+
+    init_scale: float = 2.0 ** 16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    max_scale: float = 2.0 ** 24
+    min_scale: float = 1.0
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            loss_scale=jnp.asarray(self.init_scale, jnp.float32),
+            growth_tracker=jnp.asarray(0, jnp.int32),
+        )
+
+    def scale(self, state: LossScaleState, loss: Any) -> Any:
+        """Scale the loss, upcasting to fp32 first.
+
+        The default scale (2**16) exceeds fp16 max (65504), so a
+        half-precision loss must be scaled in fp32 — the reference's loss
+        is likewise fp32 at scaling time (reductions are on amp's
+        FP32_FUNCS list).  The scaled loss stays fp32; gradient dtypes
+        follow the parameters, not the loss.
+        """
+        return jax.tree.map(
+            lambda x: x.astype(jnp.float32) * state.loss_scale, loss)
+
+    def unscale(self, state: LossScaleState, grads: Any) -> Any:
+        return tree_scale(grads, 1.0 / state.loss_scale)
+
+    def adjust(self, state: LossScaleState,
+               grads_finite: jnp.ndarray) -> LossScaleState:
+        """Scale backoff/growth state machine (``apex/amp/scaler.py``).
+
+        On overflow: scale *= backoff_factor, tracker resets.  After
+        ``growth_interval`` clean steps: scale *= growth_factor, tracker
+        resets.  Pure device-side computation — fuses into the step.
+        """
+        tracker = jnp.where(grads_finite, state.growth_tracker + 1, 0)
+        grow = tracker >= self.growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(grow,
+                      jnp.minimum(state.loss_scale * self.growth_factor,
+                                  self.max_scale),
+                      state.loss_scale),
+            jnp.maximum(state.loss_scale * self.backoff_factor,
+                        self.min_scale),
+        )
+        tracker = jnp.where(grow, 0, tracker)
+        return LossScaleState(loss_scale=new_scale.astype(jnp.float32),
+                              growth_tracker=tracker.astype(jnp.int32))
+
+    def select_step(self, grads_finite: jnp.ndarray, new_tree: Any,
+                    old_tree: Any) -> Any:
+        """``where(finite, updated, unchanged)`` over a pytree — the jit-safe
+        form of apex's "skip optimizer.step() on overflow"."""
+        return tree_select(grads_finite, new_tree, old_tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class StaticLossScale(DynamicLossScale):
+    """Constant loss scale (``amp.initialize(..., loss_scale=128.0)``)."""
+
+    scale_value: float = 1.0
+
+    def __init__(self, scale: float = 1.0):
+        # frozen dataclass: route through object.__setattr__
+        object.__setattr__(self, "init_scale", float(scale))
+        object.__setattr__(self, "growth_factor", 1.0)
+        object.__setattr__(self, "backoff_factor", 1.0)
+        object.__setattr__(self, "growth_interval", 2 ** 31 - 1)
+        object.__setattr__(self, "max_scale", float(scale))
+        object.__setattr__(self, "min_scale", float(scale))
+        object.__setattr__(self, "scale_value", float(scale))
+
+    def adjust(self, state: LossScaleState,
+               grads_finite: jnp.ndarray) -> LossScaleState:
+        return state
+
+
+class NoOpLossScale(StaticLossScale):
+    """Identity loss scale for O0/O3 and bf16 policies."""
+
+    def __init__(self):
+        super().__init__(scale=1.0)
+
+    def scale(self, state: LossScaleState, loss: Any) -> Any:
+        return loss
+
+    def unscale(self, state: LossScaleState, grads: Any) -> Any:
+        return grads

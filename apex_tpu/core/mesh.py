@@ -1,0 +1,151 @@
+"""Device-mesh topology — declarative replacement for ``parallel_state``.
+
+The reference (``apex/transformer/parallel_state.py``) builds NCCL process
+groups from ``(tensor_model_parallel_size, pipeline_model_parallel_size)``
+and exposes ``get_*_group/rank/world_size`` global accessors.  On TPU the
+topology is *declarative*: one :class:`jax.sharding.Mesh` with named axes
+
+    ``("data", "fsdp", "pipe", "tensor")``  (+ optional ``"context"``)
+
+replaces every process group.  Collectives become ``lax.psum`` etc. over an
+axis name; rank/world-size queries become mesh-shape lookups.  Axis order
+puts ``tensor`` innermost so its collectives ride the fastest ICI links
+(the analogue of apex putting TP ranks on one node's NVLink island).
+
+``context`` (sequence/ring-attention parallelism) is a TPU-native
+extension — the reference has no context parallelism (SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "MeshConfig",
+    "initialize_mesh",
+    "get_mesh",
+    "destroy_mesh",
+    "mesh_axis_size",
+    "mesh_axis_rank",
+    "DATA_AXIS",
+    "FSDP_AXIS",
+    "PIPE_AXIS",
+    "TENSOR_AXIS",
+    "CONTEXT_AXIS",
+]
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+PIPE_AXIS = "pipe"
+TENSOR_AXIS = "tensor"
+CONTEXT_AXIS = "context"
+
+# Canonical axis order: outermost (DCN-friendly) → innermost (ICI-friendly).
+AXIS_ORDER: Tuple[str, ...] = (
+    DATA_AXIS, FSDP_AXIS, PIPE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+
+# Module-level current mesh, mirroring parallel_state's module globals —
+# but holding a declarative Mesh object instead of process groups.
+_CURRENT_MESH: Optional[Mesh] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each parallelism axis (1 = axis unused).
+
+    ``data=-1`` means "infer from device count" (like apex's data-parallel
+    size being derived as ``world_size // (tp*pp)``).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    pipe: int = 1
+    context: int = 1
+    tensor: int = 1
+
+    def resolved(self, n_devices: int) -> "MeshConfig":
+        fixed = self.fsdp * self.pipe * self.context * self.tensor
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by "
+                    f"fsdp*pipe*context*tensor={fixed}")
+            data = n_devices // fixed
+        total = data * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"mesh size {total} != device count {n_devices} "
+                f"(data={data}, fsdp={self.fsdp}, pipe={self.pipe}, "
+                f"context={self.context}, tensor={self.tensor})")
+        return dataclasses.replace(self, data=data)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.data, self.fsdp, self.pipe, self.context, self.tensor)
+
+
+def initialize_mesh(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    *,
+    fsdp_size: int = 1,
+    context_parallel_size: int = 1,
+    data_parallel_size: int = -1,
+    devices: Optional[Sequence[jax.Device]] = None,
+    set_current: bool = True,
+) -> Mesh:
+    """Build the global mesh (``initialize_model_parallel`` equivalent).
+
+    Reference: ``apex/transformer/parallel_state.py::
+    initialize_model_parallel(tensor_model_parallel_size_,
+    pipeline_model_parallel_size_, ...)``.  Instead of carving the world
+    into NCCL groups, returns a named :class:`Mesh`; pass it to
+    ``jax.set_mesh`` / use as context manager.
+    """
+    if devices is None:
+        devices = jax.devices()
+    cfg = MeshConfig(
+        data=data_parallel_size,
+        fsdp=fsdp_size,
+        pipe=pipeline_model_parallel_size,
+        context=context_parallel_size,
+        tensor=tensor_model_parallel_size,
+    ).resolved(len(devices))
+    dev_array = np.asarray(devices).reshape(cfg.shape)
+    mesh = Mesh(dev_array, AXIS_ORDER)
+    if set_current:
+        global _CURRENT_MESH
+        _CURRENT_MESH = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh:
+    """Current mesh (parity: ``parallel_state.get_*_group`` accessors)."""
+    if _CURRENT_MESH is None:
+        raise RuntimeError(
+            "mesh is not initialized — call apex_tpu.initialize_mesh(...)")
+    return _CURRENT_MESH
+
+
+def destroy_mesh() -> None:
+    """Parity with ``parallel_state.destroy_model_parallel``."""
+    global _CURRENT_MESH
+    _CURRENT_MESH = None
+
+
+def mesh_axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
+    """World size of one parallel axis (``get_*_parallel_world_size``)."""
+    mesh = mesh or get_mesh()
+    return mesh.shape.get(axis, 1)
+
+
+def mesh_axis_rank(axis: str) -> jax.Array:
+    """This device's coordinate along ``axis`` — only meaningful inside
+    ``shard_map``/``pjit`` (``get_*_parallel_rank``)."""
+    return jax.lax.axis_index(axis)

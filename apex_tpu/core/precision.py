@@ -1,0 +1,226 @@
+"""Functional mixed-precision policies (apex ``amp`` opt levels O0–O3).
+
+The reference (``apex/amp/frontend.py``) resolves a string opt level to a
+``Properties`` object with fields ``cast_model_type``,
+``patch_torch_functions``, ``keep_batchnorm_fp32``, ``master_weights``,
+``loss_scale`` and then mutates the model / optimizer / torch namespace in
+place.  Here the same knobs live on an immutable :class:`PrecisionPolicy`
+that is *applied* to pytrees and module calls — no global state, no
+patching.  ``bfloat16`` is the TPU-native half type (no loss scaling
+required); ``float16`` is supported for exact behavioral parity with the
+reference including dynamic loss scaling.
+
+Opt-level semantics (mirroring ``apex/amp/frontend.py``):
+
+======  ==================  ===================  ==============  =========
+level   params kept as      compute dtype        master weights  loss scale
+======  ==================  ===================  ==============  =========
+O0      fp32                fp32                 n/a             1.0
+O1      fp32                per-op (half lists)  n/a             dynamic
+O2      half (BN fp32)      half                 fp32 masters    dynamic
+O3      half                half                 none            1.0
+======  ==================  ===================  ==============  =========
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.tree import is_floating as _is_floating
+
+__all__ = ["PrecisionPolicy", "cast_floating", "tree_cast"]
+
+DTypeLike = Any
+# Loss scale spec: "dynamic", a float, or None (no scaling).
+LossScaleSpec = Union[str, float, None]
+
+_OPT_LEVELS = ("O0", "O1", "O2", "O3")
+
+
+def cast_floating(x: Any, dtype: DTypeLike) -> Any:
+    """Cast ``x`` to ``dtype`` iff it is a floating-point array; else identity."""
+    if dtype is None:
+        return x
+    if _is_floating(x):
+        return x.astype(dtype)
+    return x
+
+
+def _default_bn_filter(path: tuple, leaf: Any) -> bool:
+    """Heuristic path filter for batch/group/layer-norm parameters.
+
+    Mirrors ``apex/amp/_initialize.py``'s special-casing of
+    ``_BatchNorm`` modules when ``keep_batchnorm_fp32`` is set: any leaf
+    whose pytree path mentions a norm layer keeps fp32.
+    """
+    for k in path:
+        name = getattr(k, "key", getattr(k, "name", None))
+        if name is None:
+            name = str(k)
+        low = str(name).lower()
+        if ("batchnorm" in low or "groupnorm" in low or "layernorm" in low
+                or low.startswith("bn") or low == "norm" or "_norm" in low
+                or "norm_" in low):
+            return True
+    return False
+
+
+def tree_cast(
+    tree: Any,
+    dtype: DTypeLike,
+    *,
+    keep_fp32_filter: Optional[Callable[[tuple, Any], bool]] = None,
+) -> Any:
+    """Cast all floating leaves of ``tree`` to ``dtype``.
+
+    ``keep_fp32_filter(path, leaf) -> bool`` exempts selected leaves
+    (kept in float32), used for ``keep_batchnorm_fp32``.
+    """
+    if dtype is None:
+        return tree
+    if keep_fp32_filter is None:
+        return jax.tree.map(lambda x: cast_floating(x, dtype), tree)
+
+    def _cast(path, leaf):
+        if _is_floating(leaf) and keep_fp32_filter(path, leaf):
+            return leaf.astype(jnp.float32)
+        return cast_floating(leaf, dtype)
+
+    return jax.tree_util.tree_map_with_path(_cast, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """Immutable description of a mixed-precision configuration.
+
+    Replaces ``apex.amp``'s ``Properties`` (``apex/amp/frontend.py``).
+    Apply with :meth:`cast_to_compute` / :meth:`cast_to_param` /
+    :meth:`cast_to_output`; feed :attr:`loss_scale` to
+    :class:`~apex_tpu.core.loss_scale.DynamicLossScale` or
+    :class:`~apex_tpu.core.loss_scale.StaticLossScale`.
+    """
+
+    opt_level: str = "O0"
+    #: dtype model params are *stored* in ("cast_model_type" upstream).
+    param_dtype: DTypeLike = jnp.float32
+    #: dtype matmuls/convs run in.
+    compute_dtype: DTypeLike = jnp.float32
+    #: dtype activations leave a policy-applied module in.
+    output_dtype: DTypeLike = jnp.float32
+    #: keep norm-layer params in fp32 even when params are half.
+    keep_batchnorm_fp32: bool = False
+    #: hold an fp32 master copy of params in the optimizer (O2).
+    master_weights: bool = False
+    #: "dynamic", a constant float, or None.
+    loss_scale: LossScaleSpec = None
+    #: O1-style per-op casting enabled (used by amp interceptors).
+    per_op_casting: bool = False
+
+    # ------------------------------------------------------------------ #
+    # constructors
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def from_opt_level(
+        cls,
+        opt_level: str,
+        *,
+        half_dtype: DTypeLike = jnp.bfloat16,
+        **overrides: Any,
+    ) -> "PrecisionPolicy":
+        """Resolve an apex opt level string to a policy.
+
+        ``half_dtype=jnp.bfloat16`` (TPU default) or ``jnp.float16`` (exact
+        reference parity).  Any field may be overridden by keyword, exactly
+        like ``amp.initialize(..., loss_scale=128.0)`` upstream.
+        """
+        if opt_level not in _OPT_LEVELS:
+            raise ValueError(
+                f"Unexpected optimization level {opt_level!r}. "
+                f"Options are 'O0', 'O1', 'O2', 'O3'.")
+        half = jnp.dtype(half_dtype)
+        # fp16 needs loss scaling; bf16 has fp32-range exponent and does not.
+        dynamic = "dynamic" if half == jnp.float16 else None
+        base = {
+            "O0": dict(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                       output_dtype=jnp.float32, keep_batchnorm_fp32=False,
+                       master_weights=False, loss_scale=None,
+                       per_op_casting=False),
+            "O1": dict(param_dtype=jnp.float32, compute_dtype=half,
+                       output_dtype=jnp.float32, keep_batchnorm_fp32=True,
+                       master_weights=False, loss_scale=dynamic,
+                       per_op_casting=True),
+            "O2": dict(param_dtype=half, compute_dtype=half,
+                       output_dtype=half, keep_batchnorm_fp32=True,
+                       master_weights=True, loss_scale=dynamic,
+                       per_op_casting=False),
+            "O3": dict(param_dtype=half, compute_dtype=half,
+                       output_dtype=half, keep_batchnorm_fp32=False,
+                       master_weights=False, loss_scale=None,
+                       per_op_casting=False),
+        }[opt_level]
+        base.update(overrides)
+        return cls(opt_level=opt_level, **base)
+
+    @classmethod
+    def O0(cls, **kw: Any) -> "PrecisionPolicy":
+        return cls.from_opt_level("O0", **kw)
+
+    @classmethod
+    def O1(cls, **kw: Any) -> "PrecisionPolicy":
+        return cls.from_opt_level("O1", **kw)
+
+    @classmethod
+    def O2(cls, **kw: Any) -> "PrecisionPolicy":
+        return cls.from_opt_level("O2", **kw)
+
+    @classmethod
+    def O3(cls, **kw: Any) -> "PrecisionPolicy":
+        return cls.from_opt_level("O3", **kw)
+
+    def with_overrides(self, **overrides: Any) -> "PrecisionPolicy":
+        return dataclasses.replace(self, **overrides)
+
+    # ------------------------------------------------------------------ #
+    # application
+    # ------------------------------------------------------------------ #
+    def _bn_filter(self) -> Optional[Callable[[tuple, Any], bool]]:
+        return _default_bn_filter if self.keep_batchnorm_fp32 else None
+
+    def cast_to_param(self, tree: Any) -> Any:
+        """Cast a param pytree to the storage dtype (apex 'cast model')."""
+        return tree_cast(tree, self.param_dtype,
+                         keep_fp32_filter=self._bn_filter())
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        """Cast inputs / params to the compute dtype for the forward pass."""
+        return tree_cast(tree, self.compute_dtype,
+                         keep_fp32_filter=self._bn_filter())
+
+    def cast_to_output(self, tree: Any) -> Any:
+        return tree_cast(tree, self.output_dtype)
+
+    def master_params(self, params: Any) -> Any:
+        """fp32 master copy of ``params`` (``amp.master_params`` upstream)."""
+        return tree_cast(params, jnp.float32)
+
+    @property
+    def needs_loss_scaling(self) -> bool:
+        if self.loss_scale is None:
+            return False
+        if self.loss_scale == "dynamic":
+            return True
+        return float(self.loss_scale) != 1.0
+
+    def make_loss_scale(self):
+        """Build the matching loss-scale manager (see ``loss_scale.py``)."""
+        from apex_tpu.core import loss_scale as ls
+
+        if self.loss_scale is None:
+            return ls.NoOpLossScale()
+        if self.loss_scale == "dynamic":
+            return ls.DynamicLossScale()
+        return ls.StaticLossScale(scale=float(self.loss_scale))

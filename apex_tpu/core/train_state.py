@@ -1,0 +1,134 @@
+"""Mixed-precision train state — the pytree that glues policy + scaler + opt.
+
+Replaces the mutated ``(model, optimizer)`` pair returned by
+``amp.initialize`` (``apex/amp/_initialize.py``,
+``apex/amp/_process_optimizer.py``): master weights, loss-scaler state and
+optimizer state live in one immutable pytree, and one jitted
+:meth:`MixedPrecisionTrainState.apply_gradients` performs the whole
+unscale → inf-check → step-or-skip → scale-adjust sequence of apex's
+``scale_loss``/``optimizer.step`` hot path (SURVEY.md §3.2) as a single
+fused computation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from apex_tpu.core.loss_scale import (
+    DynamicLossScale,
+    LossScaleState,
+    all_finite,
+)
+from apex_tpu.core.precision import PrecisionPolicy
+from apex_tpu.utils.tree import tree_select
+
+__all__ = ["MixedPrecisionTrainState"]
+
+
+class MixedPrecisionTrainState(struct.PyTreeNode):
+    """Train state with precision policy and (optional) loss scaling.
+
+    ``params`` are stored in fp32 when ``policy.master_weights`` (apex O2's
+    master weights, ``apex/fp16_utils/fp16_optimizer.py``) or when the
+    policy is full-precision; otherwise in ``policy.param_dtype`` (O3).
+    The forward pass should consume :meth:`compute_params`.
+    """
+
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    loss_scale_state: LossScaleState
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    policy: PrecisionPolicy = struct.field(pytree_node=False)
+    loss_scaler: DynamicLossScale = struct.field(pytree_node=False)
+
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def create(
+        cls,
+        *,
+        apply_fn: Callable,
+        params: Any,
+        tx: optax.GradientTransformation,
+        policy: Optional[PrecisionPolicy] = None,
+        loss_scaler: Optional[DynamicLossScale] = None,
+    ) -> "MixedPrecisionTrainState":
+        policy = policy or PrecisionPolicy.O0()
+        loss_scaler = loss_scaler or policy.make_loss_scale()
+        if policy.master_weights:
+            stored = policy.master_params(params)     # fp32 masters
+        else:
+            stored = policy.cast_to_param(params)
+        return cls(
+            step=jnp.asarray(0, jnp.int32),
+            params=stored,
+            opt_state=tx.init(stored),
+            loss_scale_state=loss_scaler.init(),
+            apply_fn=apply_fn,
+            tx=tx,
+            policy=policy,
+            loss_scaler=loss_scaler,
+        )
+
+    # ------------------------------------------------------------------ #
+    def compute_params(self) -> Any:
+        """Params cast for the forward pass (the 'model copy' of apex O2)."""
+        return self.policy.cast_to_compute(self.params)
+
+    def scale_loss(self, loss: Any) -> Any:
+        """``with amp.scale_loss(loss, opt)`` equivalent (scale only)."""
+        return self.loss_scaler.scale(self.loss_scale_state, loss)
+
+    def apply_gradients(
+        self, *, grads: Any, **kwargs: Any
+    ) -> Tuple["MixedPrecisionTrainState", jnp.ndarray]:
+        """Unscale → check → step-or-skip → adjust, all device-side.
+
+        ``grads`` are gradients of the *scaled* loss w.r.t.
+        :meth:`compute_params` (possibly half precision).  Returns
+        ``(new_state, grads_finite)`` — the flag stays on device; apex's
+        overflow print becomes the caller's choice.
+        """
+        ls, ls_state = self.loss_scaler, self.loss_scale_state
+        # upcast half grads into the params' storage dtype (fp32 masters
+        # under O2) BEFORE unscaling — the reference's multi_tensor_scale
+        # likewise writes unscaled grads directly into fp32 master grads,
+        # so tiny values aren't flushed to zero in fp16 (inf/nan survive
+        # the upcast, keeping the overflow check sound).
+        grads = jax.tree.map(
+            lambda g, p: g.astype(p.dtype) if jnp.issubdtype(
+                jnp.asarray(g).dtype, jnp.floating) else g,
+            grads, self.params)
+        grads = ls.unscale(ls_state, grads)
+        # check finiteness *after* unscale, on the unscaled grads — same
+        # ordering as apex's fused unscale+check kernel.
+        finite = all_finite(grads)
+        updates, new_opt_state = self.tx.update(
+            grads, self.opt_state, self.params, **kwargs)
+        new_params = optax.apply_updates(self.params, updates)
+        new_params = tree_select(finite, new_params, self.params)
+        new_opt_state = tree_select(finite, new_opt_state, self.opt_state)
+        new_ls_state = ls.adjust(ls_state, finite)
+        new_state = self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            loss_scale_state=new_ls_state,
+        )
+        return new_state, finite
+
+    # ------------------------------------------------------------------ #
+    # persistence parity: amp.state_dict()/load_state_dict() saved the
+    # loss-scaler state alongside model/optimizer states.
+    def amp_state_dict(self) -> dict:
+        return self.loss_scale_state.state_dict()
+
+    def load_amp_state_dict(self, d: dict) -> "MixedPrecisionTrainState":
+        return self.replace(
+            loss_scale_state=LossScaleState.from_state_dict(d))

@@ -1,0 +1,1 @@
+"""apex_tpu.models — see package docstring in apex_tpu/__init__.py."""

@@ -1,0 +1,47 @@
+"""apex_tpu.optim — fused optimizers as single-jit pytree updates.
+
+TPU-native replacement for ``apex/optimizers/*`` + the ``amp_C``
+multi-tensor CUDA kernels (``csrc/multi_tensor_*_kernel.cu``): each
+optimizer's whole-parameter-list update compiles to one fused XLA
+computation (SURVEY.md §2.2–2.3).  All are optax
+``GradientTransformation``s and compose with ``optax.chain``.
+
+Distributed ("ZeRO") variants — ``DistributedFusedAdam/LAMB`` upstream —
+are the same transforms with optimizer state sharded over the ``fsdp``
+mesh axis; see :mod:`apex_tpu.parallel.distributed_optim`.
+"""
+
+from apex_tpu.optim.fused_adam import fused_adam, FusedAdamState
+from apex_tpu.optim.fused_lamb import fused_lamb, FusedLambState
+from apex_tpu.optim.fused_sgd import fused_sgd, FusedSgdState
+from apex_tpu.optim.fused_novograd import fused_novograd, FusedNovoGradState
+from apex_tpu.optim.fused_adagrad import fused_adagrad, FusedAdagradState
+from apex_tpu.optim.larc import larc
+from apex_tpu.optim.clip import clip_grad_norm, clip_by_global_norm
+from apex_tpu.optim._multi_tensor import (
+    tree_l2_norm,
+    per_tensor_l2_norms,
+    tree_scale,
+    tree_axpby,
+    global_grad_clip_coef,
+)
+
+# Aliases matching the reference's class names for drop-in discovery.
+FusedAdam = fused_adam
+FusedLAMB = fused_lamb
+FusedSGD = fused_sgd
+FusedNovoGrad = fused_novograd
+FusedAdagrad = fused_adagrad
+LARC = larc
+
+__all__ = [
+    "fused_adam", "FusedAdamState", "FusedAdam",
+    "fused_lamb", "FusedLambState", "FusedLAMB",
+    "fused_sgd", "FusedSgdState", "FusedSGD",
+    "fused_novograd", "FusedNovoGradState", "FusedNovoGrad",
+    "fused_adagrad", "FusedAdagradState", "FusedAdagrad",
+    "larc", "LARC",
+    "clip_grad_norm", "clip_by_global_norm",
+    "tree_l2_norm", "per_tensor_l2_norms", "tree_scale", "tree_axpby",
+    "global_grad_clip_coef",
+]

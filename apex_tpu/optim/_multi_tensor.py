@@ -1,0 +1,26 @@
+"""Multi-tensor-apply semantics for optimizers — see
+:mod:`apex_tpu.utils.tree` for the shared implementations.
+
+The reference's ``apex/multi_tensor_apply/multi_tensor_apply.py`` +
+``csrc/multi_tensor_*_kernel.cu`` launch ONE fused CUDA kernel over an
+arbitrary list of tensors.  Under XLA the mechanism is unnecessary — a
+jitted pytree function compiles to fused loops — but the semantics
+("whole-parameter-list update in one compiled computation") are what
+every optimizer in this package implements.
+"""
+
+from apex_tpu.utils.tree import (
+    tree_l2_norm,
+    per_tensor_l2_norms,
+    tree_scale,
+    tree_axpby,
+    global_grad_clip_coef,
+)
+
+__all__ = [
+    "tree_l2_norm",
+    "per_tensor_l2_norms",
+    "tree_scale",
+    "tree_axpby",
+    "global_grad_clip_coef",
+]

@@ -1,0 +1,43 @@
+"""Fused gradient clipping (reference: ``apex/contrib/clip_grad/`` —
+multi-tensor ``clip_grad_norm_`` via ``amp_C.multi_tensor_l2norm`` +
+``multi_tensor_scale``).
+
+One jitted computation: fused global norm + fused scale.  Also provides
+the optax-transformation form for chaining.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.utils.tree import global_grad_clip_coef, tree_scale
+
+__all__ = ["clip_grad_norm", "clip_by_global_norm"]
+
+
+def clip_grad_norm(grads: Any, max_norm: float,
+                   *, eps: float = 1e-6) -> Tuple[Any, jnp.ndarray]:
+    """Clip ``grads`` to global L2 norm ``max_norm``.
+
+    Returns ``(clipped_grads, total_norm)`` — the reference's
+    ``clip_grad_norm_`` returns the pre-clip total norm too.
+    """
+    coef, total_norm = global_grad_clip_coef(grads, max_norm, eps=eps)
+    return tree_scale(grads, coef), total_norm
+
+
+def clip_by_global_norm(max_norm: float) -> optax.GradientTransformation:
+    """optax-style transformation form (chain before an optimizer)."""
+    def init(params):
+        del params
+        return optax.ScaleState()
+
+    def update(grads, state, params=None):
+        del params
+        clipped, _ = clip_grad_norm(grads, max_norm)
+        return clipped, state
+
+    return optax.GradientTransformation(init, update)

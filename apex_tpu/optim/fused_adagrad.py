@@ -1,0 +1,62 @@
+"""FusedAdagrad (reference: ``apex/optimizers/fused_adagrad.py`` +
+``csrc/multi_tensor_adagrad_kernel.cu``):
+
+    h += g^2 ;  p -= lr * g / (sqrt(h) + eps)
+
+with L2 weight decay folded into the gradient ("adagrad_w_mode=False"
+upstream behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["fused_adagrad", "FusedAdagradState"]
+
+
+class FusedAdagradState(NamedTuple):
+    count: jnp.ndarray
+    sum_sq: Any
+
+
+def fused_adagrad(
+    learning_rate: Union[float, optax.Schedule] = 1e-2,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+    initial_accumulator_value: float = 0.0,
+) -> optax.GradientTransformation:
+    def init(params):
+        return FusedAdagradState(
+            count=jnp.zeros((), jnp.int32),
+            sum_sq=jax.tree.map(
+                lambda p: jnp.full_like(p, initial_accumulator_value,
+                                        dtype=jnp.float32), params),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adagrad requires params")
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+
+        def leaf(g, p, h):
+            gf = g.astype(jnp.float32)
+            if weight_decay != 0.0:
+                gf = gf + weight_decay * p.astype(jnp.float32)
+            h_new = h + jnp.square(gf)
+            return (-lr * gf / (jnp.sqrt(h_new) + eps)).astype(p.dtype), h_new
+
+        g_leaves, treedef = jax.tree.flatten(grads)
+        p_leaves = treedef.flatten_up_to(params)
+        h_leaves = treedef.flatten_up_to(state.sum_sq)
+        pairs = [leaf(g, p, h) for g, p, h
+                 in zip(g_leaves, p_leaves, h_leaves)]
+        updates = treedef.unflatten([t[0] for t in pairs])
+        sums = treedef.unflatten([t[1] for t in pairs])
+        return updates, FusedAdagradState(count=count, sum_sq=sums)
+
+    return optax.GradientTransformation(init, update)

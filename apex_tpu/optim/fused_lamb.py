@@ -1,0 +1,118 @@
+"""FusedLAMB — layer-wise adaptive LAMB for large-batch training.
+
+Reference: ``apex/optimizers/fused_lamb.py`` +
+``csrc/multi_tensor_lamb_kernel.cu`` (and the two-stage
+``lamb_stage_1/lamb_stage_2`` variants).  The reference computes:
+
+1. global gradient norm over all params; clip by ``max_grad_norm``;
+2. Adam-style moments with bias correction → per-param ``update``
+   (+ decoupled weight decay term);
+3. per-parameter trust ratio ``||p|| / ||update||`` (1.0 when either
+   norm is zero), via ``multi_tensor_l2norm(per_tensor=True)``;
+4. ``p -= lr * trust_ratio * update``.
+
+Here stages 1–4 are one jitted pytree computation; the per-tensor norms
+are XLA-fused reductions (SURVEY.md §2.2 "north-star" semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.utils.tree import global_grad_clip_coef
+
+__all__ = ["fused_lamb", "FusedLambState"]
+
+
+class FusedLambState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+
+
+def fused_lamb(
+    learning_rate: Union[float, optax.Schedule] = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    bias_correction: bool = True,
+    adam_w_mode: bool = True,
+    max_grad_norm: Optional[float] = 1.0,
+    trust_clip: bool = False,
+    always_adapt: bool = False,
+) -> optax.GradientTransformation:
+    """Build the FusedLAMB gradient transformation.
+
+    ``max_grad_norm`` — global-norm clip applied to grads before the
+    update (reference default 1.0).  ``trust_clip`` clamps the trust
+    ratio at 1.  ``always_adapt=False`` (reference behavior): the trust
+    ratio is only applied when ``weight_decay != 0`` for that group —
+    here, globally.
+    """
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p)
+        return FusedLambState(
+            count=jnp.zeros((), jnp.int32),
+            exp_avg=jax.tree.map(zeros, params),
+            exp_avg_sq=jax.tree.map(zeros, params),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_lamb requires params")
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        c = count.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - jnp.power(b1, c)
+            bc2 = 1.0 - jnp.power(b2, c)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        # stage 0: fused global-norm clip (multi_tensor_l2norm + scale).
+        coef, _ = global_grad_clip_coef(grads, max_grad_norm)
+
+        use_trust = always_adapt or weight_decay != 0.0
+
+        def leaf(g, p, m, v):
+            gf = g.astype(jnp.float32) * coef
+            pf = p.astype(jnp.float32)
+            if not adam_w_mode and weight_decay != 0.0:
+                gf = gf + weight_decay * pf
+            m_new = b1 * m.astype(jnp.float32) + (1.0 - b1) * gf
+            v_new = b2 * v.astype(jnp.float32) + (1.0 - b2) * jnp.square(gf)
+            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if adam_w_mode and weight_decay != 0.0:
+                upd = upd + weight_decay * pf
+            if use_trust:
+                w_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+                u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+                # reference: ratio = w/u when both > 0, else 1.0
+                ratio = jnp.where(
+                    (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+                if trust_clip:
+                    ratio = jnp.minimum(ratio, 1.0)
+            else:
+                ratio = jnp.asarray(1.0, jnp.float32)
+            return ((-lr * ratio * upd).astype(p.dtype),
+                    m_new.astype(m.dtype), v_new.astype(v.dtype))
+
+        g_leaves, treedef = jax.tree.flatten(grads)
+        p_leaves = treedef.flatten_up_to(params)
+        m_leaves = treedef.flatten_up_to(state.exp_avg)
+        v_leaves = treedef.flatten_up_to(state.exp_avg_sq)
+        triples = [leaf(g, p, m, v) for g, p, m, v
+                   in zip(g_leaves, p_leaves, m_leaves, v_leaves)]
+        updates = treedef.unflatten([t[0] for t in triples])
+        exp_avg = treedef.unflatten([t[1] for t in triples])
+        exp_avg_sq = treedef.unflatten([t[2] for t in triples])
+        return updates, FusedLambState(count=count, exp_avg=exp_avg,
+                                       exp_avg_sq=exp_avg_sq)
+
+    return optax.GradientTransformation(init, update)

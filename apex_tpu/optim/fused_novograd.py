@@ -1,0 +1,90 @@
+"""FusedNovoGrad — NovoGrad with per-layer second moments.
+
+Reference: ``apex/optimizers/fused_novograd.py`` +
+``csrc/multi_tensor_novograd_kernel.cu``.  NovoGrad (Ginsburg et al.)
+keeps ONE scalar second moment per layer (parameter tensor):
+
+    v_t   = b2 * v_{t-1} + (1-b2) * ||g_t||^2         (scalar)
+    m_t   = b1 * m_{t-1} + (g_t / (sqrt(v_t)+eps) + wd * p)
+    p    -= lr * m_t
+
+with ``v_0 = ||g_0||^2`` on the first step (reference's ``init_v``) and
+optional gradient averaging (``grad_averaging`` scales the grad term by
+``1-b1``).  ``norm_type=2`` only (the reference also ships inf-norm).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["fused_novograd", "FusedNovoGradState"]
+
+
+class FusedNovoGradState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: Any          # per-param first moment
+    exp_avg_sq: Any       # per-LAYER scalar second moment
+
+
+def fused_novograd(
+    learning_rate: Union[float, optax.Schedule] = 1e-3,
+    b1: float = 0.95,
+    b2: float = 0.98,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_averaging: bool = False,
+    bias_correction: bool = False,
+) -> optax.GradientTransformation:
+    def init(params):
+        return FusedNovoGradState(
+            count=jnp.zeros((), jnp.int32),
+            exp_avg=jax.tree.map(jnp.zeros_like, params),
+            exp_avg_sq=jax.tree.map(
+                lambda p: jnp.zeros((), jnp.float32), params),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_novograd requires params")
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        first = state.count == 0
+        grad_coef = (1.0 - b1) if grad_averaging else 1.0
+        c = count.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - jnp.power(b1, c)
+            bc2 = 1.0 - jnp.power(b2, c)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        def leaf(g, p, m, v):
+            gf = g.astype(jnp.float32)
+            pf = p.astype(jnp.float32)
+            gnorm_sq = jnp.sum(jnp.square(gf))
+            v_new = jnp.where(first, gnorm_sq,
+                              b2 * v + (1.0 - b2) * gnorm_sq)
+            denom = jnp.sqrt(v_new / bc2) + eps
+            step_term = grad_coef * (gf / denom)
+            if weight_decay != 0.0:
+                step_term = step_term + grad_coef * weight_decay * pf
+            m_new = b1 * m.astype(jnp.float32) + step_term
+            return ((-lr * m_new / bc1).astype(p.dtype),
+                    m_new.astype(m.dtype), v_new)
+
+        g_leaves, treedef = jax.tree.flatten(grads)
+        p_leaves = treedef.flatten_up_to(params)
+        m_leaves = treedef.flatten_up_to(state.exp_avg)
+        v_leaves = treedef.flatten_up_to(state.exp_avg_sq)
+        triples = [leaf(g, p, m, v) for g, p, m, v
+                   in zip(g_leaves, p_leaves, m_leaves, v_leaves)]
+        updates = treedef.unflatten([t[0] for t in triples])
+        exp_avg = treedef.unflatten([t[1] for t in triples])
+        exp_avg_sq = treedef.unflatten([t[2] for t in triples])
+        return updates, FusedNovoGradState(
+            count=count, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,76 @@
+"""FusedSGD — momentum SGD as one jitted pytree update.
+
+Reference: ``apex/optimizers/fused_sgd.py`` +
+``csrc/multi_tensor_sgd_kernel.cu``.  Matches torch/apex SGD semantics:
+``buf = momentum*buf + (1-dampening)*g`` (weight decay folded into ``g``
+first), nesterov option, first-step momentum initialization to the
+gradient.  The amp master-weight variants of the kernel are handled by
+the train state (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["fused_sgd", "FusedSgdState"]
+
+
+class FusedSgdState(NamedTuple):
+    count: jnp.ndarray
+    momentum_buf: Any
+
+
+def fused_sgd(
+    learning_rate: Union[float, optax.Schedule] = 1e-3,
+    momentum: float = 0.0,
+    dampening: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+) -> optax.GradientTransformation:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError(
+            "Nesterov momentum requires a momentum and zero dampening")
+
+    def init(params):
+        return FusedSgdState(
+            count=jnp.zeros((), jnp.int32),
+            momentum_buf=jax.tree.map(jnp.zeros_like, params),
+        )
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_sgd requires params")
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        first = state.count == 0
+
+        def leaf(g, p, buf):
+            gf = g.astype(jnp.float32)
+            if weight_decay != 0.0:
+                gf = gf + weight_decay * p.astype(jnp.float32)
+            if momentum != 0.0:
+                # torch semantics: first step buf = g (not damped)
+                buf_new = jnp.where(
+                    first, gf, momentum * buf.astype(jnp.float32)
+                    + (1.0 - dampening) * gf)
+                d = gf + momentum * buf_new if nesterov else buf_new
+            else:
+                buf_new = buf.astype(jnp.float32)
+                d = gf
+            # keep state dtype stable across steps (scan/donation safety)
+            return (-lr * d).astype(p.dtype), buf_new.astype(buf.dtype)
+
+        g_leaves, treedef = jax.tree.flatten(grads)
+        p_leaves = treedef.flatten_up_to(params)
+        b_leaves = treedef.flatten_up_to(state.momentum_buf)
+        pairs = [leaf(g, p, b) for g, p, b
+                 in zip(g_leaves, p_leaves, b_leaves)]
+        updates = treedef.unflatten([t[0] for t in pairs])
+        bufs = treedef.unflatten([t[1] for t in pairs])
+        return updates, FusedSgdState(count=count, momentum_buf=bufs)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,67 @@
+"""LARC — Layer-wise Adaptive Rate Clipping/scaling.
+
+Reference: ``apex/parallel/LARC.py``.  The reference wraps any optimizer
+and, per parameter, rescales the gradient by the "local lr"
+
+    local_lr = trust_coefficient * ||p|| / (||g|| + wd * ||p|| + eps)
+
+- ``clip=True`` (LARC): the effective lr is ``min(local_lr, lr)``,
+  implemented by scaling the grad by ``min(local_lr/lr, 1)``.
+- ``clip=False`` (LARS): the grad is scaled by ``local_lr`` directly.
+
+Implemented as an optax-style gradient transformation to chain *before*
+the base optimizer: ``optax.chain(larc(lr, ...), fused_sgd(lr, ...))``,
+matching the reference's "wrap any optimizer" contract.  Weight decay is
+only read for the local-lr formula (the base optimizer applies it),
+exactly like the reference which pops and re-adds wd around the step.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["larc"]
+
+
+def larc(
+    learning_rate: Union[float, optax.Schedule],
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    def init(params):
+        return optax.ScaleState()
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params")
+        lr = learning_rate if not callable(learning_rate) else None
+        if lr is None:
+            raise ValueError(
+                "larc needs a concrete learning_rate float matching the "
+                "base optimizer's (schedules: pass the same callable value "
+                "per step via inject_hyperparams)")
+
+        def leaf(g, p):
+            gf = g.astype(jnp.float32)
+            pf = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+            local_lr = trust_coefficient * p_norm / (
+                g_norm + weight_decay * p_norm + eps)
+            # reference: only adapt when both norms are nonzero
+            ok = (p_norm > 0) & (g_norm > 0)
+            if clip:
+                scale = jnp.where(ok, jnp.minimum(local_lr / lr, 1.0), 1.0)
+            else:
+                scale = jnp.where(ok, local_lr, 1.0)
+            return (gf * scale).astype(g.dtype)
+
+        return jax.tree.map(leaf, grads, params), state
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,21 @@
+"""apex_tpu.utils — shared helpers (pytree numerics, misc)."""
+
+from apex_tpu.utils.tree import (
+    is_floating,
+    tree_l2_norm,
+    per_tensor_l2_norms,
+    tree_scale,
+    tree_axpby,
+    tree_select,
+    global_grad_clip_coef,
+)
+
+__all__ = [
+    "is_floating",
+    "tree_l2_norm",
+    "per_tensor_l2_norms",
+    "tree_scale",
+    "tree_axpby",
+    "tree_select",
+    "global_grad_clip_coef",
+]

@@ -1,0 +1,78 @@
+"""Shared pytree numerics helpers (lowest layer — no intra-package deps).
+
+These carry the *semantics* of the reference's ``amp_C`` multi-tensor
+kernels (``csrc/multi_tensor_{scale,axpby,l2norm}_kernel.cu`` +
+``apex/multi_tensor_apply/``): one fused computation over an entire
+tensor list.  Under XLA each helper jit-compiles to fused loops over the
+whole pytree, so the CUDA chunking machinery has no equivalent here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "is_floating",
+    "tree_l2_norm",
+    "per_tensor_l2_norms",
+    "tree_scale",
+    "tree_axpby",
+    "tree_select",
+    "global_grad_clip_coef",
+]
+
+
+def is_floating(x: Any) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def tree_l2_norm(tree: Any, *, dtype=jnp.float32) -> jnp.ndarray:
+    """Global L2 norm over all floating leaves (``amp_C.multi_tensor_l2norm``)."""
+    leaves = [l for l in jax.tree.leaves(tree) if is_floating(l)]
+    if not leaves:
+        return jnp.zeros((), dtype)
+    sq = sum(jnp.sum(jnp.square(l.astype(dtype))) for l in leaves)
+    return jnp.sqrt(sq)
+
+
+def per_tensor_l2_norms(tree: Any, *, dtype=jnp.float32) -> Any:
+    """Per-leaf L2 norms (``multi_tensor_l2norm(..., per_tensor=True)``),
+    used by LAMB's trust ratio and LARC."""
+    return jax.tree.map(
+        lambda l: jnp.sqrt(jnp.sum(jnp.square(l.astype(dtype)))), tree)
+
+
+def tree_scale(tree: Any, scale: jnp.ndarray) -> Any:
+    """``amp_C.multi_tensor_scale``: fused multiply of every floating leaf,
+    computed in fp32 and cast back to the leaf dtype."""
+    return jax.tree.map(
+        lambda l: (l.astype(jnp.float32) * scale).astype(l.dtype)
+        if is_floating(l) else l,
+        tree)
+
+
+def tree_axpby(a: jnp.ndarray, x: Any, b: jnp.ndarray, y: Any) -> Any:
+    """``amp_C.multi_tensor_axpby``: fused ``a*x + b*y`` over leaf pairs."""
+    return jax.tree.map(lambda xi, yi: a * xi + b * yi, x, y)
+
+
+def tree_select(pred: jnp.ndarray, new: Any, old: Any) -> Any:
+    """``where(pred, new, old)`` over a pytree — jit-safe step-or-skip."""
+    return jax.tree.map(lambda n, o: jnp.where(pred, n, o), new, old)
+
+
+def global_grad_clip_coef(
+    grads: Any, max_norm: Optional[float], *, eps: float = 1e-6
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Global-norm clip coefficient (``apex/contrib/clip_grad`` semantics).
+
+    Returns ``(coef, global_norm)``; ``coef`` is 1 when no clipping needed.
+    """
+    gnorm = tree_l2_norm(grads)
+    if max_norm is None:
+        return jnp.ones((), jnp.float32), gnorm
+    coef = jnp.minimum(1.0, max_norm / (gnorm + eps))
+    return coef, gnorm

@@ -1,0 +1,43 @@
+"""Test harness: hermetic multi-device testing on CPU.
+
+The reference's distributed tests require >=2 real GPUs (SURVEY.md §4).
+We do strictly better: every DP/TP/PP/SP test runs on CPU with 8 virtual
+XLA devices, so the whole suite is hermetic.  Pallas kernels run in
+interpret mode on CPU; the same code paths compile natively on TPU.
+
+This file must set env vars BEFORE jax is imported anywhere.
+"""
+
+import os
+
+# Force CPU even if the ambient environment selects a TPU platform
+# (e.g. JAX_PLATFORMS=axon): the unit suite must be hermetic and fast.
+# Set APEX_TPU_TEST_PLATFORM=tpu to run kernel tests on real hardware.
+os.environ["JAX_PLATFORMS"] = os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture
+def mesh8():
+    """An 8-device (2 data, 2 pipe, 2 tensor) mesh on virtual CPU devices."""
+    from apex_tpu.core import mesh as mesh_lib
+
+    m = mesh_lib.initialize_mesh(
+        tensor_model_parallel_size=2,
+        pipeline_model_parallel_size=2,
+        data_parallel_size=2,
+    )
+    yield m
+    mesh_lib.destroy_mesh()

@@ -1,0 +1,127 @@
+"""Dynamic loss scaling state-machine tests (reference behavior:
+``apex/amp/scaler.py`` — x2 growth after 2000 clean steps, ÷2 backoff on
+overflow, step skipping)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import DynamicLossScale, StaticLossScale, NoOpLossScale, all_finite
+from apex_tpu.core.loss_scale import LossScaleState
+
+
+class TestAllFinite:
+    def test_finite(self):
+        t = {"a": jnp.ones((3,)), "b": jnp.zeros((2, 2))}
+        assert bool(all_finite(t))
+
+    def test_nan(self):
+        t = {"a": jnp.ones((3,)), "b": jnp.asarray([1.0, jnp.nan])}
+        assert not bool(all_finite(t))
+
+    def test_inf(self):
+        t = {"a": jnp.asarray([jnp.inf])}
+        assert not bool(all_finite(t))
+
+    def test_ignores_int_leaves(self):
+        t = {"a": jnp.asarray([1, 2], jnp.int32)}
+        assert bool(all_finite(t))
+
+    def test_jittable(self):
+        f = jax.jit(all_finite)
+        assert bool(f({"a": jnp.ones((4,))}))
+        assert not bool(f({"a": jnp.asarray([jnp.nan] * 4)}))
+
+
+class TestDynamicLossScale:
+    def test_init_default(self):
+        ls = DynamicLossScale()
+        st = ls.init()
+        assert float(st.loss_scale) == 2.0 ** 16
+        assert int(st.growth_tracker) == 0
+
+    def test_scale_unscale_roundtrip(self):
+        ls = DynamicLossScale()
+        st = ls.init()
+        loss = jnp.asarray(3.5)
+        scaled = ls.scale(st, loss)
+        assert float(scaled) == 3.5 * 2 ** 16
+        grads = {"w": jnp.full((4,), 2.0 ** 16)}
+        unscaled = ls.unscale(st, grads)
+        np.testing.assert_allclose(np.asarray(unscaled["w"]), 1.0)
+
+    def test_backoff_on_overflow(self):
+        ls = DynamicLossScale()
+        st = ls.init()
+        st2 = ls.adjust(st, jnp.asarray(False))
+        assert float(st2.loss_scale) == 2.0 ** 15
+        assert int(st2.growth_tracker) == 0
+
+    def test_growth_after_interval(self):
+        ls = DynamicLossScale(growth_interval=3, init_scale=4.0)
+        st = ls.init()
+        for _ in range(2):
+            st = ls.adjust(st, jnp.asarray(True))
+            assert float(st.loss_scale) == 4.0
+        st = ls.adjust(st, jnp.asarray(True))  # 3rd clean step → grow
+        assert float(st.loss_scale) == 8.0
+        assert int(st.growth_tracker) == 0
+
+    def test_overflow_resets_tracker(self):
+        ls = DynamicLossScale(growth_interval=5)
+        st = ls.init()
+        st = ls.adjust(st, jnp.asarray(True))
+        st = ls.adjust(st, jnp.asarray(True))
+        assert int(st.growth_tracker) == 2
+        st = ls.adjust(st, jnp.asarray(False))
+        assert int(st.growth_tracker) == 0
+
+    def test_max_scale_clamp(self):
+        ls = DynamicLossScale(init_scale=2.0 ** 24, growth_interval=1)
+        st = ls.adjust(ls.init(), jnp.asarray(True))
+        assert float(st.loss_scale) == 2.0 ** 24
+
+    def test_min_scale_clamp(self):
+        ls = DynamicLossScale(init_scale=1.0)
+        st = ls.adjust(ls.init(), jnp.asarray(False))
+        assert float(st.loss_scale) == 1.0
+
+    def test_select_step_skips_on_overflow(self):
+        ls = DynamicLossScale()
+        new = {"w": jnp.ones((2,))}
+        old = {"w": jnp.zeros((2,))}
+        kept = ls.select_step(jnp.asarray(False), new, old)
+        np.testing.assert_array_equal(np.asarray(kept["w"]), 0.0)
+        took = ls.select_step(jnp.asarray(True), new, old)
+        np.testing.assert_array_equal(np.asarray(took["w"]), 1.0)
+
+    def test_adjust_jittable(self):
+        ls = DynamicLossScale()
+        f = jax.jit(ls.adjust)
+        st = f(ls.init(), jnp.asarray(False))
+        assert float(st.loss_scale) == 2.0 ** 15
+
+    def test_state_dict_roundtrip(self):
+        ls = DynamicLossScale()
+        st = ls.adjust(ls.init(), jnp.asarray(False))
+        d = st.state_dict()
+        st2 = LossScaleState.from_state_dict(d)
+        assert float(st2.loss_scale) == float(st.loss_scale)
+        assert int(st2.growth_tracker) == int(st.growth_tracker)
+
+
+class TestStaticAndNoOp:
+    def test_static_never_adjusts(self):
+        ls = StaticLossScale(scale=128.0)
+        st = ls.init()
+        assert float(st.loss_scale) == 128.0
+        st = ls.adjust(st, jnp.asarray(False))
+        assert float(st.loss_scale) == 128.0
+
+    def test_noop_identity(self):
+        ls = NoOpLossScale()
+        st = ls.init()
+        loss = jnp.asarray(2.0)
+        assert ls.scale(st, loss) is loss
+        grads = {"w": jnp.ones(3)}
+        assert ls.unscale(st, grads) is grads

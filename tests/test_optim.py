@@ -1,0 +1,295 @@
+"""Golden-reference optimizer tests.
+
+Mirrors the reference's ``tests/L0/run_optimizers/`` strategy: every
+fused optimizer is asserted against the eager composition it replaces —
+here torch.optim (CPU) for Adam(W)/SGD/Adagrad and hand-rolled numpy for
+LAMB/NovoGrad/LARC — within dtype-appropriate tolerances.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+import torch
+
+from apex_tpu import optim as ao
+
+
+def _rand_params(rng, shapes):
+    return {f"p{i}": jnp.asarray(rng.normal(size=s), jnp.float32)
+            for i, s in enumerate(shapes)}
+
+
+def _rand_grads_like(rng, params):
+    return jax.tree.map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape), jnp.float32), params)
+
+
+SHAPES = [(4, 8), (8,), (3, 5, 2)]
+
+
+def _run_jax(tx, params, grads_seq):
+    state = tx.init(params)
+    for g in grads_seq:
+        updates, state = tx.update(g, state, params)
+        params = optax.apply_updates(params, updates)
+    return params
+
+
+def _run_torch(make_opt, params, grads_seq):
+    tparams = {k: torch.tensor(np.asarray(v), requires_grad=True)
+               for k, v in params.items()}
+    opt = make_opt(list(tparams.values()))
+    for g in grads_seq:
+        for k, tp in tparams.items():
+            tp.grad = torch.tensor(np.asarray(g[k]))
+        opt.step()
+    return {k: jnp.asarray(v.detach().numpy()) for k, v in tparams.items()}
+
+
+def _assert_trees_close(a, b, rtol=1e-5, atol=1e-6):
+    jax.tree.map(lambda x, y: np.testing.assert_allclose(
+        np.asarray(x), np.asarray(y), rtol=rtol, atol=atol), a, b)
+
+
+class TestFusedAdam:
+    @pytest.mark.parametrize("wd,adam_w", [(0.0, True), (0.01, True),
+                                           (0.01, False)])
+    def test_vs_torch(self, rng, wd, adam_w):
+        params = _rand_params(rng, SHAPES)
+        grads_seq = [_rand_grads_like(rng, params) for _ in range(5)]
+        tx = ao.fused_adam(1e-2, weight_decay=wd, adam_w_mode=adam_w)
+        got = _run_jax(tx, params, grads_seq)
+        make = (lambda ps: torch.optim.AdamW(ps, lr=1e-2, weight_decay=wd)
+                ) if adam_w else (
+                lambda ps: torch.optim.Adam(ps, lr=1e-2, weight_decay=wd))
+        want = _run_torch(make, params, grads_seq)
+        _assert_trees_close(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_jit_single_step(self, rng):
+        params = _rand_params(rng, SHAPES)
+        tx = ao.fused_adam(1e-3)
+        state = tx.init(params)
+        g = _rand_grads_like(rng, params)
+
+        @jax.jit
+        def step(g, state, params):
+            return tx.update(g, state, params)
+
+        updates, state2 = step(g, state, params)
+        assert int(state2.count) == 1
+        assert jax.tree.structure(updates) == jax.tree.structure(params)
+
+    def test_tuple_structured_params(self, rng):
+        # regression: tuple pytrees must not be confused with result triples
+        params = (jnp.ones((3, 3)), jnp.ones((3,)))
+        grads = (jnp.full((3, 3), 0.1), jnp.full((3,), 0.1))
+        tx = ao.fused_adam(1e-2)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        assert isinstance(updates, tuple) and len(updates) == 2
+        assert updates[0].shape == (3, 3) and updates[1].shape == (3,)
+
+    def test_moment_dtype_option(self, rng):
+        params = {"w": jnp.ones((4, 4), jnp.bfloat16)}
+        tx = ao.fused_adam(1e-3, moment_dtype=jnp.float32)
+        st = tx.init(params)
+        assert st.exp_avg["w"].dtype == jnp.float32
+
+
+class TestFusedSGD:
+    @pytest.mark.parametrize("momentum,nesterov,wd",
+                             [(0.0, False, 0.0), (0.9, False, 0.0),
+                              (0.9, True, 0.0), (0.9, False, 1e-4)])
+    def test_vs_torch(self, rng, momentum, nesterov, wd):
+        params = _rand_params(rng, SHAPES)
+        grads_seq = [_rand_grads_like(rng, params) for _ in range(5)]
+        tx = ao.fused_sgd(0.1, momentum=momentum, nesterov=nesterov,
+                          weight_decay=wd)
+        got = _run_jax(tx, params, grads_seq)
+        want = _run_torch(
+            lambda ps: torch.optim.SGD(ps, lr=0.1, momentum=momentum,
+                                       nesterov=nesterov, weight_decay=wd),
+            params, grads_seq)
+        _assert_trees_close(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_nesterov_validation(self):
+        with pytest.raises(ValueError):
+            ao.fused_sgd(0.1, momentum=0.0, nesterov=True)
+
+
+class TestFusedAdagrad:
+    @pytest.mark.parametrize("wd", [0.0, 1e-3])
+    def test_vs_torch(self, rng, wd):
+        params = _rand_params(rng, SHAPES)
+        grads_seq = [_rand_grads_like(rng, params) for _ in range(5)]
+        tx = ao.fused_adagrad(0.05, weight_decay=wd)
+        got = _run_jax(tx, params, grads_seq)
+        want = _run_torch(
+            lambda ps: torch.optim.Adagrad(ps, lr=0.05, weight_decay=wd,
+                                           eps=1e-10),
+            params, grads_seq)
+        _assert_trees_close(got, want, rtol=1e-5, atol=1e-6)
+
+
+def _numpy_lamb_reference(params, grads_seq, lr, b1, b2, eps, wd,
+                          max_grad_norm):
+    """Direct transcription of the documented LAMB algorithm."""
+    p = {k: np.asarray(v, np.float64) for k, v in params.items()}
+    m = {k: np.zeros_like(v) for k, v in p.items()}
+    v = {k: np.zeros_like(vv) for k, vv in p.items()}
+    t = 0
+    for g in grads_seq:
+        t += 1
+        g = {k: np.asarray(vv, np.float64) for k, vv in g.items()}
+        gnorm = np.sqrt(sum(np.sum(np.square(vv)) for vv in g.values()))
+        coef = min(1.0, max_grad_norm / (gnorm + 1e-6))
+        g = {k: vv * coef for k, vv in g.items()}
+        bc1 = 1 - b1 ** t
+        bc2 = 1 - b2 ** t
+        for k in p:
+            m[k] = b1 * m[k] + (1 - b1) * g[k]
+            v[k] = b2 * v[k] + (1 - b2) * g[k] ** 2
+            upd = (m[k] / bc1) / (np.sqrt(v[k] / bc2) + eps) + wd * p[k]
+            wn = np.sqrt(np.sum(p[k] ** 2))
+            un = np.sqrt(np.sum(upd ** 2))
+            ratio = wn / un if (wn > 0 and un > 0) else 1.0
+            p[k] = p[k] - lr * ratio * upd
+    return p
+
+
+class TestFusedLAMB:
+    def test_vs_numpy_reference(self, rng):
+        params = _rand_params(rng, SHAPES)
+        grads_seq = [_rand_grads_like(rng, params) for _ in range(4)]
+        kw = dict(b1=0.9, b2=0.999, eps=1e-6, weight_decay=0.01,
+                  max_grad_norm=1.0)
+        tx = ao.fused_lamb(0.01, **kw)
+        got = _run_jax(tx, params, grads_seq)
+        want = _numpy_lamb_reference(params, grads_seq, 0.01, 0.9, 0.999,
+                                     1e-6, 0.01, 1.0)
+        _assert_trees_close(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_no_weight_decay_skips_trust_ratio(self, rng):
+        # reference semantics: trust ratio only applied when wd != 0
+        # (unless always_adapt) — so wd=0 LAMB == AdamW(wd=0) modulo clip
+        params = _rand_params(rng, SHAPES)
+        grads_seq = [_rand_grads_like(rng, params) for _ in range(3)]
+        lamb = ao.fused_lamb(1e-2, weight_decay=0.0, eps=1e-8,
+                             max_grad_norm=None)
+        adam = ao.fused_adam(1e-2, weight_decay=0.0, eps=1e-8)
+        _assert_trees_close(_run_jax(lamb, params, grads_seq),
+                            _run_jax(adam, params, grads_seq),
+                            rtol=1e-5, atol=1e-6)
+
+    def test_trust_clip(self, rng):
+        params = _rand_params(rng, [(6, 6)])
+        grads = [_rand_grads_like(rng, params)]
+        tx = ao.fused_lamb(1e-2, weight_decay=0.01, trust_clip=True)
+        _run_jax(tx, params, grads)  # smoke: compiles & runs
+
+
+class TestFusedNovoGrad:
+    def test_first_step_v_init(self, rng):
+        params = _rand_params(rng, [(4, 4)])
+        g = _rand_grads_like(rng, params)
+        tx = ao.fused_novograd(0.01, b1=0.9, b2=0.99)
+        updates, st = tx.update(g, tx.init(params), params)
+        gnorm_sq = float(jnp.sum(jnp.square(g["p0"])))
+        assert np.isclose(float(st.exp_avg_sq["p0"]), gnorm_sq, rtol=1e-5)
+        # update = -lr * m, m = g/(sqrt(v)+eps) on first step
+        want = -0.01 * (np.asarray(g["p0"]) /
+                        (np.sqrt(gnorm_sq) + 1e-8))
+        np.testing.assert_allclose(np.asarray(updates["p0"]), want,
+                                   rtol=1e-5)
+
+    def test_multi_step_decay(self, rng):
+        params = _rand_params(rng, [(3, 3), (5,)])
+        grads_seq = [_rand_grads_like(rng, params) for _ in range(4)]
+        tx = ao.fused_novograd(0.01, weight_decay=0.01)
+        out = _run_jax(tx, params, grads_seq)
+        for k in params:
+            assert not np.allclose(np.asarray(out[k]),
+                                   np.asarray(params[k]))
+
+
+class TestLARC:
+    def test_clip_mode_scales_grads(self, rng):
+        params = {"w": jnp.full((4, 4), 10.0)}
+        grads = {"w": jnp.full((4, 4), 1e-4)}
+        tx = ao.larc(0.1, trust_coefficient=0.02, clip=True)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        # local_lr = 0.02*40/(0.0016...) huge -> min(local/lr,1)=1 -> unchanged
+        np.testing.assert_allclose(np.asarray(updates["w"]),
+                                   np.asarray(grads["w"]), rtol=1e-6)
+
+    def test_lars_mode(self, rng):
+        params = {"w": jnp.full((2, 2), 2.0)}
+        grads = {"w": jnp.full((2, 2), 1.0)}
+        tx = ao.larc(0.1, trust_coefficient=0.02, clip=False)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        p_norm, g_norm = 4.0, 2.0
+        local_lr = 0.02 * p_norm / (g_norm + 1e-8)
+        np.testing.assert_allclose(np.asarray(updates["w"]),
+                                   np.asarray(grads["w"]) * local_lr,
+                                   rtol=1e-5)
+
+    def test_zero_grad_no_adapt(self):
+        params = {"w": jnp.ones((2,))}
+        grads = {"w": jnp.zeros((2,))}
+        tx = ao.larc(0.1)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        np.testing.assert_array_equal(np.asarray(updates["w"]), 0.0)
+
+    def test_chain_with_sgd(self, rng):
+        params = _rand_params(rng, [(4, 4)])
+        grads = [_rand_grads_like(rng, params) for _ in range(3)]
+        tx = optax.chain(ao.larc(0.1), ao.fused_sgd(0.1, momentum=0.9))
+        out = _run_jax(tx, params, grads)
+        assert not np.allclose(np.asarray(out["p0"]),
+                               np.asarray(params["p0"]))
+
+
+class TestClipGrad:
+    def test_clip_reduces_norm(self, rng):
+        grads = _rand_grads_like(rng, _rand_params(rng, SHAPES))
+        clipped, norm = ao.clip_grad_norm(grads, 0.5)
+        new_norm = float(ao.tree_l2_norm(clipped))
+        assert float(norm) > 0.5
+        assert np.isclose(new_norm, 0.5, rtol=1e-4)
+
+    def test_noop_when_under(self, rng):
+        grads = {"g": jnp.asarray([3e-3, 4e-3])}
+        clipped, norm = ao.clip_grad_norm(grads, 1.0)
+        np.testing.assert_allclose(np.asarray(clipped["g"]),
+                                   np.asarray(grads["g"]), rtol=1e-5)
+
+    def test_transformation_form(self, rng):
+        params = _rand_params(rng, [(4,)])
+        tx = optax.chain(ao.clip_by_global_norm(1.0), ao.fused_sgd(0.1))
+        g = {"p0": jnp.full((4,), 100.0)}
+        updates, _ = tx.update(g, tx.init(params), params)
+        # clipped to norm 1 then scaled by lr
+        np.testing.assert_allclose(
+            float(jnp.sqrt(jnp.sum(jnp.square(updates["p0"])))), 0.1,
+            rtol=1e-4)
+
+
+class TestMultiTensorHelpers:
+    def test_tree_l2_norm_vs_numpy(self, rng):
+        t = _rand_params(rng, SHAPES)
+        want = np.sqrt(sum(np.sum(np.square(np.asarray(v)))
+                           for v in t.values()))
+        assert np.isclose(float(ao.tree_l2_norm(t)), want, rtol=1e-6)
+
+    def test_per_tensor_norms(self, rng):
+        t = _rand_params(rng, [(3, 3)])
+        norms = ao.per_tensor_l2_norms(t)
+        assert np.isclose(float(norms["p0"]),
+                          np.linalg.norm(np.asarray(t["p0"])), rtol=1e-6)
+
+    def test_tree_axpby(self):
+        x = {"a": jnp.ones(3)}
+        y = {"a": jnp.full(3, 2.0)}
+        out = ao.tree_axpby(2.0, x, 3.0, y)
+        np.testing.assert_allclose(np.asarray(out["a"]), 8.0)

@@ -1,0 +1,98 @@
+"""Tests for PrecisionPolicy — mirrors the reference's L0/run_amp casting
+checks (opt-level property resolution, model cast, BN exemption)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import PrecisionPolicy
+from apex_tpu.core.precision import tree_cast
+
+
+def _params():
+    return {
+        "dense": {"kernel": jnp.ones((4, 4), jnp.float32),
+                  "bias": jnp.zeros((4,), jnp.float32)},
+        "batchnorm_0": {"scale": jnp.ones((4,), jnp.float32),
+                        "bias": jnp.zeros((4,), jnp.float32)},
+        "step": jnp.asarray(3, jnp.int32),
+    }
+
+
+class TestOptLevels:
+    def test_o0_properties(self):
+        p = PrecisionPolicy.O0()
+        assert p.param_dtype == jnp.float32
+        assert p.compute_dtype == jnp.float32
+        assert not p.master_weights
+        assert p.loss_scale is None
+        assert not p.needs_loss_scaling
+
+    def test_o1_properties_bf16(self):
+        p = PrecisionPolicy.O1()
+        assert p.param_dtype == jnp.float32
+        assert jnp.dtype(p.compute_dtype) == jnp.bfloat16
+        assert p.per_op_casting
+        # bf16 needs no loss scaling
+        assert p.loss_scale is None
+
+    def test_o1_fp16_gets_dynamic_scaling(self):
+        p = PrecisionPolicy.O1(half_dtype=jnp.float16)
+        assert p.loss_scale == "dynamic"
+        assert p.needs_loss_scaling
+
+    def test_o2_properties(self):
+        p = PrecisionPolicy.O2(half_dtype=jnp.float16)
+        assert jnp.dtype(p.param_dtype) == jnp.float16
+        assert p.keep_batchnorm_fp32
+        assert p.master_weights
+        assert p.loss_scale == "dynamic"
+
+    def test_o3_properties(self):
+        p = PrecisionPolicy.O3()
+        assert jnp.dtype(p.param_dtype) == jnp.bfloat16
+        assert not p.keep_batchnorm_fp32
+        assert not p.master_weights
+
+    def test_override_kwargs(self):
+        # parity: amp.initialize(..., loss_scale=128.0) override
+        p = PrecisionPolicy.O2(half_dtype=jnp.float16, loss_scale=128.0)
+        assert p.loss_scale == 128.0
+        p2 = PrecisionPolicy.O1(keep_batchnorm_fp32=False)
+        assert not p2.keep_batchnorm_fp32
+
+    def test_bad_level_raises(self):
+        with pytest.raises(ValueError):
+            PrecisionPolicy.from_opt_level("O4")
+
+
+class TestCasting:
+    def test_o2_cast_keeps_bn_fp32(self):
+        p = PrecisionPolicy.O2()
+        cast = p.cast_to_param(_params())
+        assert cast["dense"]["kernel"].dtype == jnp.bfloat16
+        assert cast["batchnorm_0"]["scale"].dtype == jnp.float32
+        # non-float leaves untouched
+        assert cast["step"].dtype == jnp.int32
+
+    def test_o3_casts_everything(self):
+        p = PrecisionPolicy.O3()
+        cast = p.cast_to_param(_params())
+        assert cast["batchnorm_0"]["scale"].dtype == jnp.bfloat16
+
+    def test_master_params_roundtrip(self):
+        p = PrecisionPolicy.O2()
+        half = p.cast_to_param(_params())
+        masters = p.master_params(half)
+        assert masters["dense"]["kernel"].dtype == jnp.float32
+
+    def test_tree_cast_none_is_identity(self):
+        t = _params()
+        assert tree_cast(t, None) is t
+
+    def test_values_preserved(self):
+        x = {"w": jnp.asarray(np.linspace(-2, 2, 8), jnp.float32)}
+        y = tree_cast(x, jnp.bfloat16)
+        np.testing.assert_allclose(
+            np.asarray(y["w"], np.float32), np.asarray(x["w"]),
+            rtol=2 ** -7)

@@ -1,0 +1,125 @@
+"""End-to-end mixed-precision step tests — the functional analogue of the
+reference's L0/run_amp training-loop checks (master weights update, step
+skipped on overflow, scaler state persisted)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apex_tpu import amp
+from apex_tpu.core.train_state import MixedPrecisionTrainState
+from apex_tpu.core.precision import PrecisionPolicy
+
+
+def _apply_fn(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def _make_state(opt_level="O2", half_dtype=jnp.float16, **kw):
+    params = {"w": jnp.ones((4, 2), jnp.float32) * 0.5,
+              "b": jnp.zeros((2,), jnp.float32)}
+    tx = optax.sgd(0.1)
+    return amp.initialize(_apply_fn, params, tx, opt_level,
+                          half_dtype=half_dtype, **kw)
+
+
+def _loss_fn(params, state, x, y):
+    pred = _apply_fn(params, x)
+    loss = jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+    return amp.scale_loss(loss, state)
+
+
+def test_o2_masters_are_fp32():
+    state = _make_state("O2")
+    assert state.params["w"].dtype == jnp.float32
+    assert state.compute_params()["w"].dtype == jnp.float16
+
+
+def test_o3_params_are_half():
+    state = _make_state("O3")
+    assert state.params["w"].dtype == jnp.float16
+
+
+def test_step_updates_params():
+    # scale 128 (static) so the fp16 grads of the scaled loss stay finite
+    state = _make_state("O2", loss_scale=128.0)
+    x = jnp.ones((3, 4), jnp.float16)
+    y = jnp.zeros((3, 2), jnp.float32)
+
+    @jax.jit
+    def step(state, x, y):
+        grads = jax.grad(_loss_fn)(state.compute_params(), state, x, y)
+        return state.apply_gradients(grads=grads)
+
+    new_state, finite = step(state, x, y)
+    assert bool(finite)
+    assert int(new_state.step) == 1
+    assert not np.allclose(np.asarray(new_state.params["w"]),
+                           np.asarray(state.params["w"]))
+    # masters stay fp32
+    assert new_state.params["w"].dtype == jnp.float32
+
+
+def test_overflow_skips_step_and_backs_off():
+    state = _make_state("O2")
+    bad_grads = {"w": jnp.full((4, 2), jnp.nan, jnp.float16),
+                 "b": jnp.zeros((2,), jnp.float16)}
+    new_state, finite = state.apply_gradients(grads=bad_grads)
+    assert not bool(finite)
+    np.testing.assert_array_equal(np.asarray(new_state.params["w"]),
+                                  np.asarray(state.params["w"]))
+    assert float(new_state.loss_scale_state.loss_scale) == 2.0 ** 15
+    # step counter still advances (iteration happened)
+    assert int(new_state.step) == 1
+
+
+def test_scaled_loss_value():
+    state = _make_state("O2")
+    loss = jnp.asarray(1.0)
+    assert float(state.scale_loss(loss)) == 2.0 ** 16
+
+
+def test_unscale_recovers_true_grads():
+    state = _make_state("O2", loss_scale=128.0)
+    x = jnp.ones((3, 4), jnp.float16)
+    y = jnp.zeros((3, 2), jnp.float32)
+    # grads of scaled loss
+    grads_scaled = jax.grad(_loss_fn)(state.compute_params(), state, x, y)
+    grads_ref = jax.grad(
+        lambda p: jnp.mean((_apply_fn(p, x).astype(jnp.float32) - y) ** 2)
+    )(state.policy.master_params(state.compute_params()))
+    ls = state.loss_scaler
+    unscaled = ls.unscale(state.loss_scale_state, grads_scaled)
+    np.testing.assert_allclose(
+        np.asarray(unscaled["w"], np.float32),
+        np.asarray(grads_ref["w"], np.float32), rtol=1e-2, atol=1e-3)
+
+
+def test_amp_state_dict_roundtrip():
+    state = _make_state("O2")
+    # force a backoff so state is non-default
+    state, _ = state.apply_gradients(
+        grads={"w": jnp.full((4, 2), jnp.nan, jnp.float16),
+               "b": jnp.zeros((2,), jnp.float16)})
+    d = amp.state_dict(state)
+    fresh = _make_state("O2")
+    restored = amp.load_state_dict(fresh, d)
+    assert float(restored.loss_scale_state.loss_scale) == \
+        float(state.loss_scale_state.loss_scale)
+
+
+def test_o0_no_scaling_path():
+    state = _make_state("O0", half_dtype=jnp.bfloat16)
+    x = jnp.ones((3, 4), jnp.float32)
+    y = jnp.zeros((3, 2), jnp.float32)
+    grads = jax.grad(_loss_fn)(state.compute_params(), state, x, y)
+    new_state, finite = state.apply_gradients(grads=grads)
+    assert bool(finite)
+    assert new_state.params["w"].dtype == jnp.float32
+
+
+def test_bf16_o2_no_loss_scaling():
+    state = _make_state("O2", half_dtype=jnp.bfloat16)
+    assert not state.policy.needs_loss_scaling
+    assert state.compute_params()["w"].dtype == jnp.bfloat16
