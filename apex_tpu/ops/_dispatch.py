@@ -1,0 +1,53 @@
+"""Implementation dispatch for fused ops.
+
+Each op in :mod:`apex_tpu.ops` ships (a) a Pallas TPU kernel and (b) an
+XLA (plain jnp) composition with identical semantics — the golden
+reference the kernel is tested against, and the fallback on CPU/GPU.
+This mirrors the reference's import-try pattern (every
+``apex/contrib/*`` python half falls back or skips when its CUDA ext
+isn't built) but resolution here is per-call and explicit.
+
+``implementation=`` accepted values:
+
+- ``"auto"``   — Pallas on TPU backends, XLA elsewhere (default);
+- ``"pallas"`` — force the Pallas kernel (compiled);
+- ``"pallas_interpret"`` — Pallas kernel in interpreter mode (runs on
+  CPU; used by the hermetic kernel tests);
+- ``"xla"``    — force the jnp composition.
+
+Env override ``APEX_TPU_OPS_IMPL`` sets the default for "auto".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["resolve_impl", "use_interpret"]
+
+_VALID = ("auto", "pallas", "pallas_interpret", "xla")
+
+
+def resolve_impl(implementation: Optional[str], *,
+                 pallas_ok: bool = True) -> str:
+    """Resolve an ``implementation`` argument to a concrete choice.
+
+    ``pallas_ok=False`` signals the caller's shapes are outside the
+    kernel's support envelope (e.g. unaligned hidden size) — "auto"
+    then resolves to "xla".
+    """
+    impl = implementation or os.environ.get("APEX_TPU_OPS_IMPL", "auto")
+    if impl not in _VALID:
+        raise ValueError(
+            f"implementation={impl!r} not in {_VALID}")
+    if impl == "auto":
+        if pallas_ok and jax.default_backend() == "tpu":
+            return "pallas"
+        return "xla"
+    return impl
+
+
+def use_interpret(impl: str) -> bool:
+    return impl == "pallas_interpret"

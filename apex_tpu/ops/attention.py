@@ -1,0 +1,398 @@
+"""Fused multi-head attention — flash-attention Pallas kernels.
+
+Reference: ``apex/contrib/multihead_attn`` (~10 fused CUDA kernels:
+self/enc-dec attention, norm-add/bias/mask variants) and
+``apex/contrib/fmha`` (fixed-seqlen fused MHA, seqlen ≤ 512) — both
+pre-flash-era fused attention (SURVEY.md §2.7, "north-star op").
+
+TPU design — a single flash-attention family subsumes the whole kernel
+zoo, exactly as flash attention subsumed them upstream:
+
+- **forward**: grid ``(batch*heads, q_blocks, kv_blocks)``; the TPU
+  executes the last grid axis sequentially, so VMEM scratch carries the
+  online-softmax state (running max ``m``, normalizer ``l``, fp32
+  accumulator) across kv steps; softmax statistics (logsumexp) are
+  written out for the backward.  O(S) memory — the fmha/multihead_attn
+  kernels' O(S²) score tensor never materializes.
+- **backward**: ``delta = rowsum(dO·O)`` (XLA), then two Pallas kernels:
+  ``dq`` accumulates over kv blocks; ``dk/dv`` accumulate over q blocks —
+  probabilities recomputed from the saved logsumexp (flash-2 style).
+- causal masking is generated in-kernel from block indices; fully-masked
+  kv blocks are skipped via ``pl.when`` (block-sparse fast path).
+
+Layout: ``(batch, seq, heads, head_dim)`` (BSHD).  MQA/GQA: pass k/v
+with fewer heads and ``num_kv_heads`` dividing ``num_heads``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._dispatch import resolve_impl
+
+__all__ = ["fused_attention", "attention_reference"]
+
+_NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------- #
+# XLA reference composition (golden semantics; CPU/GPU fallback)
+# --------------------------------------------------------------------- #
+def attention_reference(q, k, v, *, causal: bool = False,
+                        scale: Optional[float] = None, bias=None):
+    """Eager attention: softmax(q·kᵀ·scale + bias [causal]) · v.
+
+    Shapes: q (b, sq, h, d); k/v (b, sk, hk, d) with h % hk == 0.
+    """
+    b, sq, h, d = q.shape
+    hk = k.shape[2]
+    scale = (d ** -0.5) if scale is None else scale
+    if hk != h:                                    # GQA: repeat kv heads
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sk = k.shape[1]
+        q_idx = jnp.arange(sq)[:, None]
+        k_idx = jnp.arange(sk)[None, :]
+        s = jnp.where(k_idx > q_idx + (sk - sq), _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+# --------------------------------------------------------------------- #
+# forward kernel
+# --------------------------------------------------------------------- #
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   acc_ref, m_ref, l_ref, *,
+                   scale, causal, bq, bk, sk_blocks, sq, sk):
+    j = pl.program_id(2)
+    i = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal block skip: kv block j is live iff its first key position
+    # <= last query position (+ rectangular offset)
+    q_last = (i + 1) * bq - 1 + (sk - sq)
+    block_live = jnp.logical_or(not causal, j * bk <= q_last)
+
+    @pl.when(block_live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)          # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(k_pos > q_pos + (sk - sq), _NEG_INF, s)
+        m_prev = m_ref[:]                          # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                     # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)            # (bq, 1)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(j == sk_blocks - 1)
+    def _final():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+
+
+def _run_fa_fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    grid = (bh, sq // bq, sk // bk)
+    kernel = functools.partial(
+        _fa_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        sk_blocks=sk // bk, sq=sq, sk=sk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+# --------------------------------------------------------------------- #
+# backward kernels
+# --------------------------------------------------------------------- #
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, acc_ref, *,
+                      scale, causal, bq, bk, sk_blocks, sq, sk):
+    j = pl.program_id(2)
+    i = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_last = (i + 1) * bq - 1 + (sk - sq)
+    block_live = jnp.logical_or(not causal, j * bk <= q_last)
+
+    @pl.when(block_live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]                  # (bq, 1)
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(k_pos > q_pos + (sk - sq), _NEG_INF, s)
+        p = jnp.exp(s - lse)                       # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bq, bk)
+        ds = p * (dp - delta) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == sk_blocks - 1)
+    def _final():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *,
+                       scale, causal, bq, bk, sq_blocks, sq, sk):
+    i = pl.program_id(2)      # q block (sequential axis)
+    j = pl.program_id(1)      # kv block
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_last = (i + 1) * bq - 1 + (sk - sq)
+    block_live = jnp.logical_or(not causal, j * bk <= q_last)
+
+    @pl.when(block_live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(k_pos > q_pos + (sk - sq), _NEG_INF, s)
+        p = jnp.exp(s - lse)                       # (bq, bk)
+        # dv += pᵀ @ do
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale              # (bq, bk)
+        # dk += dsᵀ @ q
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == sq_blocks - 1)
+    def _final():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _run_fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk,
+                interpret):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)                       # (bh, sq)
+
+    dq_kernel = functools.partial(
+        _fa_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        sk_blocks=sk // bk, sq=sq, sk=sk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, sq // bq, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _fa_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        sq_blocks=sq // bq, sq=sq, sk=sk)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, sk // bk, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------- #
+# custom VJP over (b*h, s, d) arrays
+# --------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fa_pallas(q3, k3, v3, scale, causal, bq, bk, interpret):
+    o, _ = _run_fa_fwd(q3, k3, v3, scale, causal, bq, bk, interpret)
+    return o
+
+
+def _fa_pallas_fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
+    o, lse = _run_fa_fwd(q3, k3, v3, scale, causal, bq, bk, interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _fa_pallas_bwd(scale, causal, bq, bk, interpret, res, do):
+    q3, k3, v3, o, lse = res
+    dq, dk, dv = _run_fa_bwd(q3, k3, v3, o, lse, do, scale, causal,
+                             bq, bk, interpret)
+    return dq, dk, dv
+
+
+_fa_pallas.defvjp(_fa_pallas_fwd, _fa_pallas_bwd)
+
+
+# --------------------------------------------------------------------- #
+# public API
+# --------------------------------------------------------------------- #
+def fused_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    bias=None,
+                    block_q: int = 128, block_k: int = 128,
+                    implementation: Optional[str] = None):
+    """Flash multi-head attention (BSHD layout), O(S) memory.
+
+    Drop-in for the reference's ``SelfMultiheadAttn`` core /
+    ``fmha`` (SURVEY.md §2.7).  ``bias`` (additive, e.g. relative
+    position) currently routes to the XLA path.  GQA/MQA supported via
+    fewer kv heads.
+    """
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    scale = (d ** -0.5) if scale is None else float(scale)
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    pallas_ok = (
+        bias is None
+        and d % 128 == 0
+        and sq % bq == 0 and sk % bk == 0
+        and q.dtype == k.dtype == v.dtype
+    )
+    impl = resolve_impl(implementation, pallas_ok=pallas_ok)
+    if impl == "xla" or not pallas_ok:
+        return attention_reference(q, k, v, causal=causal, scale=scale,
+                                   bias=bias)
+    interpret = impl == "pallas_interpret"
+    if hk != h:                                    # GQA: expand kv heads
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    # (b, s, h, d) -> (b*h, s, d)
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    o3 = _fa_pallas(q3, k3, v3, scale, bool(causal), bq, bk, interpret)
+    return o3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

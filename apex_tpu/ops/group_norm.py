@@ -1,0 +1,72 @@
+"""Fused GroupNorm (+ optional SiLU) — NHWC, diffusion-workload oriented.
+
+Reference: ``apex/contrib/group_norm`` and ``group_norm_v2`` (+
+``apex/contrib/csrc/group_norm*``) — NHWC GroupNorm with fused SiLU
+("swish") epilogue, built for diffusion UNets.
+
+TPU design: channels-last is already the native TPU conv layout.  The
+computation — per-(sample, group) statistics then affine + activation —
+is expressed as one traced region with fp32 statistics; XLA fuses the
+normalize/affine/SiLU chain into the surrounding convs.  A dedicated
+Pallas kernel is unnecessary: group statistics are small reductions XLA
+schedules well (unlike row-softmax/LN where fusing the two passes
+matters).  Cited rationale: SURVEY.md §2.7 group_norm row.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+__all__ = ["group_norm", "GroupNorm"]
+
+
+def group_norm(x, num_groups: int, weight=None, bias=None, *,
+               eps: float = 1e-5, act: Optional[str] = None):
+    """GroupNorm over an NHWC (or N...C) tensor, optional fused SiLU.
+
+    ``x``: (N, ..., C) channels-last.  ``act``: None | "silu".
+    """
+    c = x.shape[-1]
+    if c % num_groups != 0:
+        raise ValueError(f"channels {c} not divisible by groups {num_groups}")
+    orig_shape = x.shape
+    n = x.shape[0]
+    xf = x.astype(jnp.float32).reshape(n, -1, num_groups, c // num_groups)
+    mean = jnp.mean(xf, axis=(1, 3), keepdims=True)
+    var = jnp.var(xf, axis=(1, 3), keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y.reshape(orig_shape)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    if act == "silu":
+        y = y * jax.nn.sigmoid(y)
+    elif act is not None:
+        raise ValueError(f"unknown act {act!r}")
+    return y.astype(x.dtype)
+
+
+class GroupNorm(nn.Module):
+    """Module form (``apex.contrib.group_norm.GroupNorm`` parity, NHWC)."""
+
+    num_groups: int
+    epsilon: float = 1e-5
+    use_scale: bool = True
+    use_bias: bool = True
+    act: Optional[str] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        weight = (self.param("scale", nn.initializers.ones_init(), (c,),
+                             self.param_dtype) if self.use_scale else None)
+        bias = (self.param("bias", nn.initializers.zeros_init(), (c,),
+                           self.param_dtype) if self.use_bias else None)
+        return group_norm(x, self.num_groups, weight, bias,
+                          eps=self.epsilon, act=self.act)

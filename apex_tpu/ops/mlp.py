@@ -1,0 +1,135 @@
+"""Fused dense / MLP blocks.
+
+Reference: ``apex/mlp/mlp.py`` + ``csrc/mlp.cpp``/``mlp_cuda.cu``
+(cuBLASLt-backed fused MLP) and ``apex/fused_dense/fused_dense.py`` +
+``csrc/fused_dense*`` (dense+bias and dense+bias+GeLU with fused
+epilogues/backwards).
+
+On TPU these exist *as modules, not kernels*: XLA's fusion pass already
+attaches bias-add and activation epilogues to the MXU matmul and fuses
+the backward's dgelu into the grad matmuls — the exact optimization the
+reference hand-codes against cuBLASLt (SURVEY.md §2.4 "XLA already
+fuses dense+bias+act").  The modules below express the computation in
+one traced region with fp32 MXU accumulation (``preferred_element_type``)
+so the compiler sees the whole epilogue chain; a Pallas matmul-epilogue
+kernel is only warranted for exotic epilogues XLA can't fuse.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+__all__ = ["FusedDense", "FusedDenseGeluDense", "MLP", "fused_dense"]
+
+
+def fused_dense(x, kernel, bias=None, activation: Optional[str] = None):
+    """dense(+bias)(+activation) as one fusable expression.
+
+    fp32 accumulation on the MXU; output in ``x.dtype`` (reference:
+    ``fused_dense_cuda`` runs fp16 GEMM with fp32 accumulate).
+    """
+    y = jax.lax.dot_general(
+        x, kernel,
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    if activation == "gelu":
+        y = jax.nn.gelu(y, approximate=False)
+    elif activation == "relu":
+        y = jax.nn.relu(y)
+    elif activation == "sigmoid":
+        y = jax.nn.sigmoid(y)
+    elif activation is not None:
+        raise ValueError(f"unknown activation {activation!r}")
+    return y.astype(x.dtype)
+
+
+class FusedDense(nn.Module):
+    """Linear + bias in one fused region (``apex.fused_dense.FusedDense``)."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", self.kernel_init,
+                            (x.shape[-1], self.features), self.param_dtype)
+        bias = (self.param("bias", self.bias_init, (self.features,),
+                           self.param_dtype) if self.use_bias else None)
+        dtype = self.dtype or x.dtype
+        x = x.astype(dtype)
+        kernel = kernel.astype(dtype)
+        if bias is not None:
+            bias = bias.astype(dtype)
+        return fused_dense(x, kernel, bias)
+
+
+class FusedDenseGeluDense(nn.Module):
+    """dense→bias→GeLU→dense→bias in one region
+    (``apex.fused_dense.FusedDenseGeluDense``)."""
+
+    intermediate_features: int
+    out_features: int
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = self.dtype or x.dtype
+        k1 = self.param("kernel1", self.kernel_init,
+                        (x.shape[-1], self.intermediate_features),
+                        self.param_dtype)
+        b1 = self.param("bias1", self.bias_init,
+                        (self.intermediate_features,), self.param_dtype)
+        k2 = self.param("kernel2", self.kernel_init,
+                        (self.intermediate_features, self.out_features),
+                        self.param_dtype)
+        b2 = self.param("bias2", self.bias_init,
+                        (self.out_features,), self.param_dtype)
+        x = x.astype(dtype)
+        h = fused_dense(x, k1.astype(dtype), b1.astype(dtype), "gelu")
+        return fused_dense(h, k2.astype(dtype), b2.astype(dtype))
+
+
+class MLP(nn.Module):
+    """Stack of dense+bias+activation layers (``apex.mlp.MLP``).
+
+    ``mlp_sizes`` are the hidden/output widths after the input layer,
+    matching the reference's constructor; activation applies to every
+    layer except the last (reference behavior).
+    """
+
+    mlp_sizes: Sequence[int]
+    activation: str = "relu"
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = self.dtype or x.dtype
+        x = x.astype(dtype)
+        n = len(self.mlp_sizes)
+        for i, width in enumerate(self.mlp_sizes):
+            kernel = self.param(f"kernel_{i}", self.kernel_init,
+                                (x.shape[-1], width), self.param_dtype)
+            bias = (self.param(f"bias_{i}", self.bias_init, (width,),
+                               self.param_dtype) if self.use_bias else None)
+            act = self.activation if i < n - 1 else None
+            x = fused_dense(x, kernel.astype(dtype),
+                            None if bias is None else bias.astype(dtype),
+                            act)
+        return x

@@ -1,0 +1,174 @@
+"""Fused rotary positional embedding (RoPE) — Pallas TPU kernel.
+
+Reference: ``apex/transformer/functional/fused_rope.py`` +
+``csrc/megatron/fused_rotary_positional_embedding.{h,cpp}``,
+``fused_rotary_positional_embedding_cuda.cu``
+(``fused_apply_rotary_pos_emb`` and the cached/2D/thd variants).  The
+reference fuses the rotate-and-scale of Q/K by per-position cos/sin
+tables into one kernel fwd and one bwd (bwd = same rotation with
+negated sin).
+
+TPU design: x is viewed as ``(batch*heads, seq, head_dim)``; the grid
+tiles (bh, seq-block); cos/sin (seq, head_dim/2) tables are looked up
+per seq-block and applied on the VPU in fp32.  Supports both layouts:
+
+- ``interleave=False`` ("half" / NeoX-Llama style, reference's
+  ``rotary_interleaved=False``): rotate ``[x1, x2] -> [x1*cos - x2*sin,
+  x2*cos + x1*sin]`` with x1/x2 the two halves of the head dim.
+- partial rotary (``rot_dim < head_dim``): the tail passes through, as
+  in the reference (GPT-NeoX rotary_pct).
+
+The VJP is the transpose rotation — implemented by calling the same
+kernel with ``sin`` negated, exactly like the reference's backward.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._dispatch import resolve_impl
+
+__all__ = ["fused_rope", "rope_reference", "rope_cos_sin"]
+
+
+def rope_cos_sin(seq_len: int, rot_dim: int, *, base: float = 10000.0,
+                 dtype=jnp.float32):
+    """Build (seq, rot_dim/2) cos/sin tables (reference's freqs cache)."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, rot_dim, 2,
+                                          dtype=jnp.float32) / rot_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)                 # (seq, rot_dim/2)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def rope_reference(x, cos, sin):
+    """Eager jnp composition (half-rotation / NeoX style).
+
+    ``x``: (..., seq, heads, head_dim) or (..., seq, head_dim);
+    cos/sin: (seq, rot_dim/2).  The rotary span is ``2*cos.shape[-1]``;
+    any remaining tail of head_dim passes through unchanged.
+    """
+    rot_dim = 2 * cos.shape[-1]
+    x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
+    half = rot_dim // 2
+    x1, x2 = x_rot[..., :half], x_rot[..., half:]
+    # locate the seq axis to broadcast cos/sin over any head axis between
+    # it and head_dim: (b, s, h, d) and (s, h, d) have seq at -3;
+    # (b, s, d) has seq at -2.
+    seq = cos.shape[0]
+    if x.ndim >= 3 and x.shape[-3] == seq:
+        c = cos[:, None, :]
+        s = sin[:, None, :]
+    elif x.shape[-2] == seq:
+        c, s = cos, sin
+    else:
+        raise ValueError(
+            f"no axis of {x.shape} matches cos seq length {seq}")
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    o1 = xf1 * c - xf2 * s
+    o2 = xf2 * c + xf1 * s
+    return jnp.concatenate(
+        [o1.astype(x.dtype), o2.astype(x.dtype), x_pass], axis=-1)
+
+
+# --------------------------------------------------------------------- #
+# Pallas kernel
+# --------------------------------------------------------------------- #
+def _rope_kernel(x_ref, cos_ref, sin_ref, y_ref, *, half, rot_dim):
+    x = x_ref[:]                                   # (1, bs, d)
+    c = cos_ref[:].astype(jnp.float32)             # (bs, half)
+    s = sin_ref[:].astype(jnp.float32)
+    x1 = x[0, :, :half].astype(jnp.float32)
+    x2 = x[0, :, half:rot_dim].astype(jnp.float32)
+    o1 = (x1 * c - x2 * s).astype(y_ref.dtype)
+    o2 = (x2 * c + x1 * s).astype(y_ref.dtype)
+    y_ref[0, :, :half] = o1
+    y_ref[0, :, half:rot_dim] = o2
+    if rot_dim < x.shape[-1]:
+        y_ref[0, :, rot_dim:] = x[0, :, rot_dim:]
+
+
+def _run_rope(x3d, cos, sin, interpret):
+    bh, seq, d = x3d.shape
+    half = cos.shape[-1]
+    rot_dim = 2 * half
+    bs = min(seq, 512)
+    grid = (bh, pl.cdiv(seq, bs))
+    kernel = functools.partial(_rope_kernel, half=half, rot_dim=rot_dim)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bs, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, half), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, half), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bs, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, seq, d), x3d.dtype),
+        interpret=interpret,
+    )(x3d, cos, sin)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _rope_pallas(x3d, cos, sin, interpret):
+    return _run_rope(x3d, cos, sin, interpret)
+
+
+def _rope_pallas_fwd(x3d, cos, sin, interpret):
+    return _run_rope(x3d, cos, sin, interpret), (cos, sin)
+
+
+def _rope_pallas_bwd(interpret, res, dy):
+    cos, sin = res
+    # transpose rotation = rotation by -theta (reference backward kernel)
+    dx = _run_rope(dy, cos, -sin, interpret)
+    return dx, None, None
+
+
+_rope_pallas.defvjp(_rope_pallas_fwd, _rope_pallas_bwd)
+
+
+# --------------------------------------------------------------------- #
+# public API
+# --------------------------------------------------------------------- #
+def fused_rope(x, cos, sin, *, implementation: Optional[str] = None):
+    """Apply rotary position embedding, fused.
+
+    ``x``: ``(batch, seq, heads, head_dim)``, ``(seq, heads, head_dim)``
+    or ``(batch, seq, head_dim)``; ``cos``/``sin``: ``(seq, rot_dim/2)``
+    from :func:`rope_cos_sin`.  Rotates the first ``rot_dim`` channels,
+    passes the tail through (partial rotary).
+    """
+    half = cos.shape[-1]
+    d = x.shape[-1]
+    impl = resolve_impl(
+        implementation, pallas_ok=(half % 128 == 0 and d % 128 == 0))
+    if impl == "xla":
+        return rope_reference(x, cos, sin)
+    interpret = impl == "pallas_interpret"
+    orig = x.shape
+    if x.ndim == 4:                       # (b, s, h, d) -> (b*h, s, d)
+        b, s, h, _ = x.shape
+        x3 = x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+        y = _rope_pallas(x3, cos, sin, interpret)
+        return y.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    if x.ndim == 3:                       # (s, h, d) or (b, s, d)
+        # treat axis 0/1 as (rows, seq): normalize to (rows, s, d)
+        s = cos.shape[0]
+        if x.shape[0] == s:               # (s, h, d) -> (h, s, d)
+            x3 = x.transpose(1, 0, 2)
+            y = _rope_pallas(x3, cos, sin, interpret)
+            return y.transpose(1, 0, 2)
+        x3 = x                            # (b, s, d)
+        return _rope_pallas(x3, cos, sin, interpret)
+    raise ValueError(f"unsupported rope input rank {x.ndim}")

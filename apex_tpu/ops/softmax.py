@@ -1,0 +1,205 @@
+"""Fused scale-mask-softmax — Pallas TPU kernel with custom VJP.
+
+Reference: ``apex/transformer/functional/fused_softmax.py`` +
+``csrc/megatron/scaled_masked_softmax*.cu``,
+``scaled_upper_triang_masked_softmax*.cu`` and
+``generic_scaled_masked_softmax*.cu`` (FusedScaleMaskSoftmax).  The
+reference fuses ``softmax(x * scale + mask)`` fwd/bwd for attention
+scores in fp16/bf16.
+
+TPU design: rows (collapsed leading dims) blocked into VMEM; scale,
+additive mask and the numerically-stable softmax computed in fp32 on the
+VPU in one pass; causal (upper-triangular) masking generated in-kernel
+from the row's query index (no mask tensor materialized — the analogue
+of the reference's dedicated ``upper_triang`` kernel).  Backward is the
+standard ``dx = (dy - sum(dy*y)) * y * scale`` in a second kernel using
+the saved probabilities.
+
+The long-term replacement for this op is the fused attention kernel
+(:mod:`apex_tpu.ops.attention`), exactly as flash-attention subsumed
+these kernels upstream (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._dispatch import resolve_impl
+
+__all__ = ["fused_scale_mask_softmax", "scale_mask_softmax_reference"]
+
+_NEG = -30000.0  # large-negative fill, safe in fp16 (reference uses -10000)
+
+
+# --------------------------------------------------------------------- #
+# XLA reference composition
+# --------------------------------------------------------------------- #
+def scale_mask_softmax_reference(x, mask=None, scale: float = 1.0,
+                                 causal: bool = False):
+    """Eager jnp composition: ``softmax(x*scale masked_fill mask)``.
+
+    ``mask`` is boolean, True = masked out (reference convention).
+    ``causal`` applies an upper-triangular mask over the last two dims.
+    """
+    xf = x.astype(jnp.float32) * scale
+    if mask is not None:
+        xf = jnp.where(mask, _NEG, xf)
+    if causal:
+        sq, sk = x.shape[-2], x.shape[-1]
+        q_idx = jnp.arange(sq)[:, None]
+        k_idx = jnp.arange(sk)[None, :]
+        cmask = k_idx > (q_idx + (sk - sq))
+        xf = jnp.where(cmask, _NEG, xf)
+    y = jax.nn.softmax(xf, axis=-1)
+    return y.astype(x.dtype)
+
+
+# --------------------------------------------------------------------- #
+# Pallas kernels
+# --------------------------------------------------------------------- #
+def _pick_block_rows(n_rows: int, width: int) -> int:
+    budget = (2 * 1024 * 1024) // max(1, width * 4)
+    br = max(8, min(256, budget))
+    br = (br // 8) * 8
+    return max(8, min(br, max(8, n_rows)))
+
+
+def _softmax_fwd_kernel(x_ref, y_ref, *, scale, causal, sq, sk, has_mask,
+                        mask_ref=None):
+    x = x_ref[:].astype(jnp.float32) * scale
+    if has_mask:
+        x = jnp.where(mask_ref[:], _NEG, x)
+    if causal:
+        i = pl.program_id(0)
+        br = x_ref.shape[0]
+        row0 = i * br
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+        q_pos = rows % sq
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        x = jnp.where(k_pos > (q_pos + (sk - sq)), _NEG, x)
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    y = e / jnp.sum(e, axis=1, keepdims=True)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+
+def _softmax_bwd_kernel(dy_ref, y_ref, dx_ref, *, scale):
+    dy = dy_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    inner = jnp.sum(dy * y, axis=1, keepdims=True)
+    dx_ref[:] = ((dy - inner) * y * scale).astype(dx_ref.dtype)
+
+
+def _run_softmax_fwd(x2d, mask2d, scale, causal, sq, sk, interpret):
+    n, w = x2d.shape
+    br = _pick_block_rows(n, w)
+    grid = (pl.cdiv(n, br),)
+    has_mask = mask2d is not None
+    if has_mask:
+        def kernel(x_ref, mask_ref, y_ref):
+            _softmax_fwd_kernel(x_ref, y_ref, scale=scale, causal=causal,
+                                sq=sq, sk=sk, has_mask=True,
+                                mask_ref=mask_ref)
+        in_specs = [
+            pl.BlockSpec((br, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        args = (x2d, mask2d)
+    else:
+        def kernel(x_ref, y_ref):
+            _softmax_fwd_kernel(x_ref, y_ref, scale=scale, causal=causal,
+                                sq=sq, sk=sk, has_mask=False)
+        in_specs = [
+            pl.BlockSpec((br, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        args = (x2d,)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((br, w), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, w), x2d.dtype),
+        interpret=interpret,
+    )(*args)
+
+
+def _run_softmax_bwd(dy2d, y2d, scale, interpret):
+    n, w = y2d.shape
+    br = _pick_block_rows(n, w)
+    grid = (pl.cdiv(n, br),)
+    kernel = functools.partial(_softmax_bwd_kernel, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((br, w), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, w), dy2d.dtype),
+        interpret=interpret,
+    )(dy2d, y2d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _softmax_pallas(x2d, mask2d, scale, causal, sq, sk, interpret):
+    return _run_softmax_fwd(x2d, mask2d, scale, causal, sq, sk, interpret)
+
+
+def _softmax_pallas_fwd(x2d, mask2d, scale, causal, sq, sk, interpret):
+    y = _run_softmax_fwd(x2d, mask2d, scale, causal, sq, sk, interpret)
+    return y, y
+
+
+def _softmax_pallas_bwd(scale, causal, sq, sk, interpret, y, dy):
+    dx = _run_softmax_bwd(dy, y, scale, interpret)
+    return dx, None
+
+
+_softmax_pallas.defvjp(_softmax_pallas_fwd, _softmax_pallas_bwd)
+
+
+# --------------------------------------------------------------------- #
+# public API
+# --------------------------------------------------------------------- #
+def fused_scale_mask_softmax(
+    x,
+    mask=None,
+    *,
+    scale: float = 1.0,
+    causal: bool = False,
+    implementation: Optional[str] = None,
+):
+    """``softmax(x * scale, masked)`` over the last axis, fused.
+
+    - ``x``: scores, typically ``(batch, heads, sq, sk)``, fp32/bf16/fp16.
+    - ``mask``: optional boolean, True = masked; broadcastable to ``x``.
+    - ``causal``: apply upper-triangular causal masking in-kernel
+      (reference's ``scaled_upper_triang_masked_softmax``).
+    """
+    sk = x.shape[-1]
+    sq = x.shape[-2] if x.ndim >= 2 else 1
+    impl = resolve_impl(implementation, pallas_ok=(sk % 128 == 0))
+    if impl == "xla":
+        return scale_mask_softmax_reference(x, mask, scale, causal)
+    interpret = impl == "pallas_interpret"
+    x2d = x.reshape(-1, sk)
+    mask2d = None
+    if mask is not None:
+        mask2d = jnp.broadcast_to(mask, x.shape).reshape(-1, sk)
+    y = _softmax_pallas(x2d, mask2d, float(scale), bool(causal),
+                        sq, sk, interpret)
+    return y.reshape(x.shape)

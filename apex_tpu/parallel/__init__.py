@@ -1,1 +1,34 @@
-"""apex_tpu.parallel — see package docstring in apex_tpu/__init__.py."""
+"""apex_tpu.parallel — single-axis distributed building blocks.
+
+TPU-native replacement for ``apex/parallel`` (SURVEY.md §2.5): data
+parallelism and SyncBatchNorm ride ICI collectives inserted by GSPMD
+instead of NCCL hooks; LARC lives in :mod:`apex_tpu.optim`.
+"""
+
+from apex_tpu.parallel.ddp import (
+    DistributedDataParallel,
+    replicate,
+    shard_batch,
+    all_reduce_mean_grads,
+)
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    sync_batch_norm_stats,
+    convert_syncbn_model,
+)
+from apex_tpu.parallel.distributed_optim import (
+    distributed_fused_adam,
+    distributed_fused_lamb,
+    zero_param_specs,
+    zero_shardings,
+)
+from apex_tpu.optim import LARC
+
+__all__ = [
+    "DistributedDataParallel", "replicate", "shard_batch",
+    "all_reduce_mean_grads",
+    "SyncBatchNorm", "sync_batch_norm_stats", "convert_syncbn_model",
+    "distributed_fused_adam", "distributed_fused_lamb",
+    "zero_param_specs", "zero_shardings",
+    "LARC",
+]

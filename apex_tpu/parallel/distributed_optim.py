@@ -1,0 +1,78 @@
+"""Distributed ("ZeRO"-sharded) fused optimizers.
+
+Reference: ``apex/contrib/optimizers/distributed_fused_adam.py`` /
+``distributed_fused_lamb.py`` — optimizer state and master params
+sharded across the DP group; gradients reduce-scattered into shards
+during backward (bucketed, overlapped), updated shard-locally, params
+all-gathered after the step (SURVEY.md §2.7).
+
+TPU translation: the reduce-scatter/all-gather choreography IS the
+GSPMD lowering of "optimizer state sharded over the ``fsdp`` axis" —
+XLA inserts a reduce-scatter for the grads feeding sharded state, runs
+the (already fused, :mod:`apex_tpu.optim`) update shard-locally, and
+all-gathers params where the forward needs them, overlapping both with
+compute.  So the distributed variants are *placement policies* over the
+same transforms:
+
+    tx = distributed_fused_adam(lr)            # == fused_adam
+    shardings = zero_shardings(mesh, params)   # state/master specs
+    train_step = jit(step, in_shardings=(shardings.state, ...))
+
+``zero_shardings`` computes per-leaf PartitionSpecs that shard the
+*largest* dim of each ≥1-D leaf over ``fsdp`` (ZeRO-1/2 equivalent);
+scalars stay replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from apex_tpu.core import mesh as mesh_lib
+from apex_tpu.core.mesh import FSDP_AXIS
+from apex_tpu.optim import fused_adam, fused_lamb
+
+__all__ = [
+    "distributed_fused_adam",
+    "distributed_fused_lamb",
+    "zero_param_specs",
+    "zero_shardings",
+]
+
+# The transforms are identical — distribution is placement, not math.
+distributed_fused_adam = fused_adam
+distributed_fused_lamb = fused_lamb
+
+
+def _leaf_spec(leaf, axis: str, axis_size: int) -> PartitionSpec:
+    shape = jnp.shape(leaf)
+    if not shape:
+        return PartitionSpec()
+    # shard the largest divisible dim; else replicate
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % axis_size == 0 and shape[i] >= axis_size:
+            spec = [None] * len(shape)
+            spec[i] = axis
+            return PartitionSpec(*spec)
+    return PartitionSpec()
+
+
+def zero_param_specs(params: Any, *, axis: str = FSDP_AXIS,
+                     mesh=None) -> Any:
+    """Per-leaf PartitionSpecs sharding each tensor over ``fsdp``."""
+    mesh = mesh or mesh_lib.get_mesh()
+    n = mesh.shape.get(axis, 1)
+    return jax.tree.map(lambda p: _leaf_spec(p, axis, n), params)
+
+
+def zero_shardings(tree: Any, *, axis: str = FSDP_AXIS, mesh=None) -> Any:
+    """Per-leaf NamedShardings for params/opt-state pytrees (apply with
+    ``jax.device_put`` or as ``jit`` in/out shardings)."""
+    mesh = mesh or mesh_lib.get_mesh()
+    specs = zero_param_specs(tree, axis=axis, mesh=mesh)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, PartitionSpec))

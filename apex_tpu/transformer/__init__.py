@@ -1,1 +1,41 @@
-"""apex_tpu.transformer — see package docstring in apex_tpu/__init__.py."""
+"""apex_tpu.transformer — Megatron-style model parallelism on a mesh.
+
+TPU-native port of ``apex/transformer`` (SURVEY.md §2.6): tensor /
+sequence parallelism over named mesh axes instead of NCCL process
+groups; collectives via GSPMD sharding or explicit shard_map mappings.
+(Pipeline-parallel schedules land in ``pipeline_parallel``.)
+"""
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import mappings
+from apex_tpu.transformer import random
+from apex_tpu.transformer.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.cross_entropy import vocab_parallel_cross_entropy
+from apex_tpu.transformer.utils import (
+    divide,
+    ensure_divisibility,
+    split_tensor_along_last_dim,
+)
+from apex_tpu.transformer.enums import (
+    LayerType,
+    AttnType,
+    AttnMaskType,
+    ModelType,
+)
+
+__all__ = [
+    "parallel_state", "mappings", "random",
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "column_parallel_linear", "row_parallel_linear",
+    "vocab_parallel_embedding",
+    "vocab_parallel_cross_entropy",
+    "divide", "ensure_divisibility", "split_tensor_along_last_dim",
+    "LayerType", "AttnType", "AttnMaskType", "ModelType",
+]

@@ -1,0 +1,70 @@
+"""Vocab-parallel cross entropy.
+
+Reference: ``apex/transformer/tensor_parallel/cross_entropy.py`` —
+``vocab_parallel_cross_entropy(logits, target)``: with logits sharded
+over the vocab dim across the TP group, computes CE without gathering
+the full vocab: (1) all-reduce-max for stability, (2) masked local
+target-logit lookup + all-reduce, (3) local exp-sum + all-reduce.
+
+TPU form: the same three collectives as ``lax.pmax``/``psum`` inside
+``shard_map``; gradients flow through JAX transposition (the reference
+hand-writes the backward — softmax minus one-hot — which autodiff
+produces here from the same forward, with the max term
+stop-gradiented as usual).  Label smoothing matches
+:mod:`apex_tpu.ops.xentropy` semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.core.mesh import TENSOR_AXIS
+from apex_tpu.transformer.mappings import reduce_from_tensor_parallel_region as _reduce_from
+
+__all__ = ["vocab_parallel_cross_entropy"]
+
+
+def vocab_parallel_cross_entropy(logits_shard, target, *,
+                                 smoothing: float = 0.0,
+                                 axis: str = TENSOR_AXIS):
+    """Per-example CE from vocab-sharded logits (inside ``shard_map``).
+
+    ``logits_shard``: (..., vocab/tp) this rank's vocab slice;
+    ``target``: (...) global vocab ids.  Returns fp32 loss of
+    ``target.shape``.
+    """
+    lf = logits_shard.astype(jnp.float32)
+    per = lf.shape[-1]
+    start = lax.axis_index(axis) * per
+
+    # (1) global max for numerical stability (bwd: treated as constant;
+    # stop_gradient BEFORE pmax — the collective has no JVP rule)
+    local_max = lax.stop_gradient(jnp.max(lf, axis=-1))
+    global_max = lax.pmax(local_max, axis)
+    lf = lf - global_max[..., None]
+
+    # (2) target logit: masked local pick + all-reduce
+    in_range = (target >= start) & (target < start + per)
+    local_ids = jnp.clip(target - start, 0, per - 1)
+    picked = jnp.take_along_axis(lf, local_ids[..., None], axis=-1)[..., 0]
+    picked = jnp.where(in_range, picked, 0.0)
+    # all-reduce with identity backward (Megatron "g"): the loss is
+    # replicated across TP ranks, so a raw psum would 4x-count the
+    # cotangent — the custom-VJP mapping is load-bearing here.
+    picked = _reduce_from(picked, axis)
+
+    # (3) global log-sum-exp
+    sum_exp = _reduce_from(jnp.sum(jnp.exp(lf), axis=-1), axis)
+    lse = jnp.log(sum_exp)
+
+    nll = lse - picked
+    if smoothing > 0.0:
+        vocab = per * lax.axis_size(axis)
+        mean_logit = _reduce_from(jnp.sum(lf, axis=-1), axis) / vocab
+        smooth = lse - mean_logit
+        return (1.0 - smoothing) * nll + smoothing * smooth
+    return nll

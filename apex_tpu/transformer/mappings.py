@@ -1,0 +1,192 @@
+"""Tensor-parallel autograd collectives (the Megatron "f"/"g" functions).
+
+Reference: ``apex/transformer/tensor_parallel/mappings.py`` — the four
+autograd-paired collectives over the TP process group, plus the
+sequence-parallel pair:
+
+==============================  ===========  ============
+function                        forward      backward
+==============================  ===========  ============
+``copy_to_...``         ("f")   identity     all-reduce
+``reduce_from_...``     ("g")   all-reduce   identity
+``scatter_to_...``              slice chunk  all-gather
+``gather_from_...``             all-gather   slice chunk
+``reduce_scatter_to_sequence_parallel_...``  reduce-scatter  all-gather
+``gather_from_sequence_parallel_...``        all-gather      reduce-scatter
+==============================  ===========  ============
+
+TPU translation: these are ``custom_vjp`` functions over named mesh
+axes, usable inside ``shard_map``; the collectives are
+``lax.psum`` / ``lax.all_gather`` / ``lax.psum_scatter`` riding ICI.
+When layers are expressed with GSPMD sharding specs instead
+(:mod:`apex_tpu.transformer.layers`), XLA inserts these same collectives
+automatically and the duality is handled by transposition — these
+explicit forms exist for schedule-controlled (``shard_map``) code, which
+is exactly the role the reference's mappings play for Megatron.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.core.mesh import TENSOR_AXIS
+
+__all__ = [
+    "copy_to_tensor_parallel_region",
+    "reduce_from_tensor_parallel_region",
+    "scatter_to_tensor_parallel_region",
+    "gather_from_tensor_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "scatter_to_sequence_parallel_region",
+]
+
+
+# --------------------------------------------------------------------- #
+# f: identity fwd / all-reduce bwd
+# --------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_parallel_region(x, axis: str = TENSOR_AXIS):
+    """Megatron ``f``: replicated input entering a TP-sharded block."""
+    return x
+
+
+def _copy_fwd(x, axis):
+    return x, None
+
+
+def _copy_bwd(axis, _, g):
+    return (lax.psum(g, axis),)
+
+
+copy_to_tensor_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+# --------------------------------------------------------------------- #
+# g: all-reduce fwd / identity bwd
+# --------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_parallel_region(x, axis: str = TENSOR_AXIS):
+    """Megatron ``g``: partial sums leaving a TP-sharded block."""
+    return lax.psum(x, axis)
+
+
+def _reduce_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _reduce_bwd(axis, _, g):
+    return (g,)
+
+
+reduce_from_tensor_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# --------------------------------------------------------------------- #
+# scatter / gather along the last (feature) dim
+# --------------------------------------------------------------------- #
+def _split_dim(x, axis_name, dim):
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    size = x.shape[dim] // n
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=dim)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_to_tensor_parallel_region(x, axis: str = TENSOR_AXIS,
+                                      dim: int = -1):
+    """Slice this rank's feature chunk (fwd) / all-gather (bwd)."""
+    return _split_dim(x, axis, dim)
+
+
+def _scatter_fwd(x, axis, dim):
+    return _split_dim(x, axis, dim), None
+
+
+def _scatter_bwd(axis, dim, _, g):
+    return (lax.all_gather(g, axis, axis=dim, tiled=True),)
+
+
+scatter_to_tensor_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_tensor_parallel_region(x, axis: str = TENSOR_AXIS,
+                                       dim: int = -1):
+    """All-gather feature chunks (fwd) / slice own chunk (bwd)."""
+    return lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+def _gather_fwd(x, axis, dim):
+    return lax.all_gather(x, axis, axis=dim, tiled=True), None
+
+
+def _gather_bwd(axis, dim, _, g):
+    return (_split_dim(g, axis, dim),)
+
+
+gather_from_tensor_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# --------------------------------------------------------------------- #
+# sequence-parallel pair (Korthikanti et al.; reference's SP mappings)
+# --------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_to_sequence_parallel_region(x, axis: str = TENSOR_AXIS,
+                                               dim: int = 0):
+    """Reduce partial sums and scatter along sequence dim (fwd);
+    all-gather (bwd).  Exit of a TP block under sequence parallelism."""
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
+
+
+def _rs_fwd(x, axis, dim):
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True), None
+
+
+def _rs_bwd(axis, dim, _, g):
+    return (lax.all_gather(g, axis, axis=dim, tiled=True),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_rs_fwd, _rs_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, axis: str = TENSOR_AXIS,
+                                         dim: int = 0):
+    """All-gather sequence shards (fwd); reduce-scatter (bwd).  Entry of
+    a TP block under sequence parallelism."""
+    return lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+def _gs_fwd(x, axis, dim):
+    return lax.all_gather(x, axis, axis=dim, tiled=True), None
+
+
+def _gs_bwd(axis, dim, _, g):
+    return (lax.psum_scatter(g, axis, scatter_dimension=dim, tiled=True),)
+
+
+gather_from_sequence_parallel_region.defvjp(_gs_fwd, _gs_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_to_sequence_parallel_region(x, axis: str = TENSOR_AXIS,
+                                        dim: int = 0):
+    """Slice this rank's sequence chunk (fwd) / all-gather (bwd) —
+    used on embeddings entering an SP region."""
+    return _split_dim(x, axis, dim)
+
+
+def _ss_fwd(x, axis, dim):
+    return _split_dim(x, axis, dim), None
+
+
+def _ss_bwd(axis, dim, _, g):
+    return (lax.all_gather(g, axis, axis=dim, tiled=True),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_ss_fwd, _ss_bwd)

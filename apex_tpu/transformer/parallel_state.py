@@ -1,0 +1,138 @@
+"""API-parity facade over the declarative mesh.
+
+Reference: ``apex/transformer/parallel_state.py`` —
+``initialize_model_parallel(tensor_model_parallel_size_,
+pipeline_model_parallel_size_, virtual_pipeline_model_parallel_size_,
+...)`` plus ~30 ``get_*`` accessors over NCCL process groups.
+
+Here every "group" is a named mesh axis (SURVEY.md §2.6 "the central
+design pivot"); the accessors below return axis names / sizes so code
+written against the reference's API reads naturally.  Rank accessors are
+only meaningful inside ``shard_map``/``pjit`` (they trace to
+``lax.axis_index``), reflecting that on TPU "which rank am I" is a
+per-device question inside the program, not a process-global.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from apex_tpu.core import mesh as mesh_lib
+from apex_tpu.core.mesh import (
+    DATA_AXIS, FSDP_AXIS, PIPE_AXIS, TENSOR_AXIS, CONTEXT_AXIS,
+)
+
+__all__ = [
+    "initialize_model_parallel",
+    "model_parallel_is_initialized",
+    "destroy_model_parallel",
+    "get_tensor_model_parallel_world_size",
+    "get_pipeline_model_parallel_world_size",
+    "get_data_parallel_world_size",
+    "get_context_parallel_world_size",
+    "get_tensor_model_parallel_rank",
+    "get_pipeline_model_parallel_rank",
+    "get_data_parallel_rank",
+    "get_tensor_model_parallel_axis",
+    "get_pipeline_model_parallel_axis",
+    "get_data_parallel_axis",
+    "is_pipeline_first_stage",
+    "is_pipeline_last_stage",
+    "get_virtual_pipeline_model_parallel_world_size",
+]
+
+_VIRTUAL_PIPE_SIZE: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    *,
+    context_parallel_size_: int = 1,
+    fsdp_size_: int = 1,
+    **kwargs,
+):
+    """Build the global mesh (reference-compatible signature)."""
+    global _VIRTUAL_PIPE_SIZE
+    _VIRTUAL_PIPE_SIZE = virtual_pipeline_model_parallel_size_
+    return mesh_lib.initialize_mesh(
+        tensor_model_parallel_size=tensor_model_parallel_size_,
+        pipeline_model_parallel_size=pipeline_model_parallel_size_,
+        context_parallel_size=context_parallel_size_,
+        fsdp_size=fsdp_size_,
+        **kwargs,
+    )
+
+
+def model_parallel_is_initialized() -> bool:
+    try:
+        mesh_lib.get_mesh()
+        return True
+    except RuntimeError:
+        return False
+
+
+def destroy_model_parallel() -> None:
+    global _VIRTUAL_PIPE_SIZE
+    _VIRTUAL_PIPE_SIZE = None
+    mesh_lib.destroy_mesh()
+
+
+# ------------------------- world sizes ------------------------------- #
+def get_tensor_model_parallel_world_size() -> int:
+    return mesh_lib.mesh_axis_size(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return mesh_lib.mesh_axis_size(PIPE_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    return (mesh_lib.mesh_axis_size(DATA_AXIS)
+            * mesh_lib.mesh_axis_size(FSDP_AXIS))
+
+
+def get_context_parallel_world_size() -> int:
+    return mesh_lib.mesh_axis_size(CONTEXT_AXIS)
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PIPE_SIZE
+
+
+# ------------------------- ranks (in-program) ------------------------ #
+def get_tensor_model_parallel_rank():
+    return jax.lax.axis_index(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PIPE_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DATA_AXIS)
+
+
+def is_pipeline_first_stage():
+    return jax.lax.axis_index(PIPE_AXIS) == 0
+
+
+def is_pipeline_last_stage():
+    return (jax.lax.axis_index(PIPE_AXIS)
+            == mesh_lib.mesh_axis_size(PIPE_AXIS) - 1)
+
+
+# ------------------------- axis names -------------------------------- #
+def get_tensor_model_parallel_axis() -> str:
+    return TENSOR_AXIS
+
+
+def get_pipeline_model_parallel_axis() -> str:
+    return PIPE_AXIS
+
+
+def get_data_parallel_axis() -> str:
+    return DATA_AXIS

@@ -1,0 +1,67 @@
+"""Model-parallel RNG + activation checkpointing.
+
+Reference: ``apex/transformer/tensor_parallel/random.py`` —
+``CudaRNGStatesTracker`` / ``model_parallel_cuda_manual_seed`` maintain
+separate CUDA RNG streams per tensor-parallel rank (so dropout differs
+across TP ranks where it must, and matches where it must), and
+``checkpoint`` re-runs the forward with the RNG state replayed.
+
+TPU translation: JAX RNG is functional, so the entire stateful tracker
+collapses to key derivation — fold the mesh coordinate into the key.
+RNG replay under recomputation is free (same key → same bits), so
+activation checkpointing is just :func:`jax.checkpoint` with a policy;
+provided here with reference-shaped names.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from apex_tpu.core.mesh import TENSOR_AXIS, DATA_AXIS
+
+__all__ = [
+    "model_parallel_rng_key",
+    "data_parallel_rng_key",
+    "checkpoint",
+    "CHECKPOINT_POLICIES",
+]
+
+
+def model_parallel_rng_key(key, axis: str = TENSOR_AXIS):
+    """Per-TP-rank key (tracker's 'model-parallel-rng' stream).
+
+    Inside ``shard_map``/``pjit``: distinct stream per tensor rank —
+    use for dropout on TP-sharded activations.
+    """
+    return jax.random.fold_in(key, lax.axis_index(axis))
+
+
+def data_parallel_rng_key(key, axis: str = DATA_AXIS):
+    """Per-DP-rank key (distinct dropout per data shard)."""
+    return jax.random.fold_in(key, lax.axis_index(axis))
+
+
+#: Named remat policies ≙ Megatron's 'full'/'selective' recompute knobs.
+CHECKPOINT_POLICIES = {
+    "full": None,  # recompute everything (reference 'full' recompute)
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "nothing": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def checkpoint(fn, *, policy: Optional[str] = "full",
+               prevent_cse: bool = True):
+    """Activation checkpointing (reference ``tensor_parallel.checkpoint``).
+
+    Wrap a layer/block function; the backward recomputes activations
+    (RNG replay is automatic — functional keys).  ``policy`` selects
+    what XLA may keep (see :data:`CHECKPOINT_POLICIES`).
+    """
+    pol = CHECKPOINT_POLICIES[policy] if isinstance(policy, str) else policy
+    if pol is None:
+        return jax.checkpoint(fn, prevent_cse=prevent_cse)
+    return jax.checkpoint(fn, policy=pol, prevent_cse=prevent_cse)

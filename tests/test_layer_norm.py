@@ -1,0 +1,173 @@
+"""Golden tests for fused layer norm / RMSNorm — the reference pattern
+(``tests/L0/run_fused_layer_norm``): fused kernel vs the eager
+composition it replaces, fwd and bwd, across dtypes.  The Pallas kernel
+runs in interpret mode on CPU (hermetic); identical code compiles on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu import ops
+
+H = 256  # lane-aligned hidden size so the Pallas path engages
+
+
+def _x(rng, shape, dtype=jnp.float32):
+    return jnp.asarray(rng.normal(size=shape), dtype)
+
+
+class TestLayerNormForward:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_pallas_vs_reference(self, rng, dtype):
+        x = _x(rng, (4, 6, H), dtype)
+        w = _x(rng, (H,)) + 1.0
+        b = _x(rng, (H,))
+        got = ops.fused_layer_norm(x, w, b,
+                                   implementation="pallas_interpret")
+        want = ops.layer_norm_reference(x, w, b)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=1e-5 if dtype == jnp.float32 else 2e-2, atol=1e-5)
+
+    def test_vs_torch(self, rng):
+        x = _x(rng, (8, H))
+        w = _x(rng, (H,)) + 1.0
+        b = _x(rng, (H,))
+        got = ops.fused_layer_norm(x, w, b,
+                                   implementation="pallas_interpret")
+        want = torch.nn.functional.layer_norm(
+            torch.tensor(np.asarray(x)), (H,),
+            torch.tensor(np.asarray(w)), torch.tensor(np.asarray(b)))
+        np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_no_affine(self, rng):
+        x = _x(rng, (8, H))
+        got = ops.fused_layer_norm(x, implementation="pallas_interpret")
+        want = ops.layer_norm_reference(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_unaligned_h_falls_back(self, rng):
+        x = _x(rng, (4, 100))  # 100 % 128 != 0 → auto resolves to XLA
+        w = _x(rng, (100,))
+        got = ops.fused_layer_norm(x, w, implementation="auto")
+        want = ops.layer_norm_reference(x, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
+    def test_ragged_rows(self, rng):
+        # rows not a multiple of the block size
+        x = _x(rng, (13, H))
+        w = _x(rng, (H,))
+        b = _x(rng, (H,))
+        got = ops.fused_layer_norm(x, w, b,
+                                   implementation="pallas_interpret")
+        want = ops.layer_norm_reference(x, w, b)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestLayerNormBackward:
+    def test_grads_vs_torch(self, rng):
+        x_np = rng.normal(size=(6, H)).astype(np.float32)
+        w_np = (rng.normal(size=(H,)) + 1.0).astype(np.float32)
+        b_np = rng.normal(size=(H,)).astype(np.float32)
+        dy_np = rng.normal(size=(6, H)).astype(np.float32)
+
+        def f(x, w, b):
+            y = ops.fused_layer_norm(x, w, b,
+                                     implementation="pallas_interpret")
+            return jnp.sum(y * jnp.asarray(dy_np))
+
+        dx, dw, db = jax.grad(f, argnums=(0, 1, 2))(
+            jnp.asarray(x_np), jnp.asarray(w_np), jnp.asarray(b_np))
+
+        xt = torch.tensor(x_np, requires_grad=True)
+        wt = torch.tensor(w_np, requires_grad=True)
+        bt = torch.tensor(b_np, requires_grad=True)
+        yt = torch.nn.functional.layer_norm(xt, (H,), wt, bt)
+        (yt * torch.tensor(dy_np)).sum().backward()
+
+        np.testing.assert_allclose(np.asarray(dx), xt.grad.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dw), wt.grad.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(db), bt.grad.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_grads_xla_path_match_pallas(self, rng):
+        x = _x(rng, (5, H))
+        w = _x(rng, (H,)) + 0.5
+
+        def loss(impl):
+            def f(x, w):
+                return jnp.sum(
+                    ops.fused_layer_norm(x, w, implementation=impl) ** 2)
+            return jax.grad(f, argnums=(0, 1))(x, w)
+
+        dx_p, dw_p = loss("pallas_interpret")
+        dx_x, dw_x = loss("xla")
+        np.testing.assert_allclose(np.asarray(dx_p), np.asarray(dx_x),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dw_p), np.asarray(dw_x),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestRMSNorm:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_forward(self, rng, dtype):
+        x = _x(rng, (4, 3, H), dtype)
+        w = _x(rng, (H,)) + 1.0
+        got = ops.fused_rms_norm(x, w, implementation="pallas_interpret")
+        want = ops.rms_norm_reference(x, w)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=1e-5 if dtype == jnp.float32 else 2e-2, atol=1e-5)
+
+    def test_backward_vs_autodiff_of_reference(self, rng):
+        x = _x(rng, (6, H))
+        w = _x(rng, (H,)) + 1.0
+
+        def f_fused(x, w):
+            return jnp.sum(jnp.sin(
+                ops.fused_rms_norm(x, w,
+                                   implementation="pallas_interpret")))
+
+        def f_ref(x, w):
+            return jnp.sum(jnp.sin(ops.rms_norm_reference(x, w)))
+
+        dx_f, dw_f = jax.grad(f_fused, argnums=(0, 1))(x, w)
+        dx_r, dw_r = jax.grad(f_ref, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(dx_f), np.asarray(dx_r),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dw_f), np.asarray(dw_r),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_rms_norm_torch_parity(self, rng):
+        x_np = rng.normal(size=(4, H)).astype(np.float32)
+        w_np = (rng.normal(size=(H,)) * 0.1 + 1.0).astype(np.float32)
+        got = ops.fused_rms_norm(jnp.asarray(x_np), jnp.asarray(w_np),
+                                 implementation="pallas_interpret")
+        want = torch.nn.functional.rms_norm(
+            torch.tensor(x_np), (H,), torch.tensor(w_np), eps=1e-5)
+        np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestMixedPrecisionVariants:
+    def test_mixed_fused_half_x_fp32_params(self, rng):
+        # MixedFusedLayerNorm parity: half activations, fp32 params
+        x = _x(rng, (4, H), jnp.bfloat16)
+        w = _x(rng, (H,), jnp.float32) + 1.0
+        b = _x(rng, (H,), jnp.float32)
+        y = ops.fused_layer_norm(x, w, b,
+                                 implementation="pallas_interpret")
+        assert y.dtype == jnp.bfloat16
+        want = ops.layer_norm_reference(x, w, b)
+        np.testing.assert_allclose(np.asarray(y, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=2e-2, atol=1e-2)

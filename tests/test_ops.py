@@ -1,0 +1,267 @@
+"""Golden tests for softmax / RoPE / xentropy / MLP / GroupNorm ops —
+reference pattern: fused (Pallas-interpret) vs eager composition vs
+torch, fwd and bwd (SURVEY.md §4, ``tests/L0/run_transformer`` style)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu import ops
+
+
+def _x(rng, shape, dtype=jnp.float32):
+    return jnp.asarray(rng.normal(size=shape), dtype)
+
+
+SK = 256  # lane-aligned key length
+
+
+class TestScaleMaskSoftmax:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_plain(self, rng, dtype):
+        x = _x(rng, (2, 4, 8, SK), dtype)
+        got = ops.fused_scale_mask_softmax(
+            x, scale=0.5, implementation="pallas_interpret")
+        want = ops.scale_mask_softmax_reference(x, scale=0.5)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=2e-2 if dtype == jnp.bfloat16 else 1e-5, atol=1e-5)
+
+    def test_boolean_mask(self, rng):
+        x = _x(rng, (2, 2, 4, SK))
+        mask = jnp.asarray(rng.random((2, 1, 4, SK)) > 0.7)
+        got = ops.fused_scale_mask_softmax(
+            x, mask, scale=2.0, implementation="pallas_interpret")
+        want = ops.scale_mask_softmax_reference(x, mask, scale=2.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_causal_in_kernel(self, rng):
+        x = _x(rng, (2, 2, SK, SK))
+        got = ops.fused_scale_mask_softmax(
+            x, causal=True, scale=0.125,
+            implementation="pallas_interpret")
+        want = ops.scale_mask_softmax_reference(x, causal=True, scale=0.125)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+        # strictly-upper-triangular must be exactly ~0
+        up = np.triu(np.ones((SK, SK), bool), k=1)
+        assert np.all(np.asarray(got)[..., up] < 1e-6)
+
+    def test_causal_rectangular(self, rng):
+        # sq != sk: causal offset (sk - sq) like the reference generic kernel
+        x = _x(rng, (1, 1, 64, SK))
+        got = ops.fused_scale_mask_softmax(
+            x, causal=True, implementation="pallas_interpret")
+        want = ops.scale_mask_softmax_reference(x, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_backward_vs_reference_autodiff(self, rng):
+        x = _x(rng, (2, 2, 8, SK))
+
+        def f_fused(x):
+            y = ops.fused_scale_mask_softmax(
+                x, scale=0.7, causal=True,
+                implementation="pallas_interpret")
+            return jnp.sum(y * y)
+
+        def f_ref(x):
+            y = ops.scale_mask_softmax_reference(x, scale=0.7, causal=True)
+            return jnp.sum(y * y)
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(f_fused)(x)),
+            np.asarray(jax.grad(f_ref)(x)), rtol=1e-4, atol=1e-6)
+
+    def test_vs_torch_softmax(self, rng):
+        x_np = rng.normal(size=(3, SK)).astype(np.float32)
+        got = ops.fused_scale_mask_softmax(
+            jnp.asarray(x_np), scale=1.0,
+            implementation="pallas_interpret")
+        want = torch.softmax(torch.tensor(x_np), dim=-1)
+        np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestRope:
+    def test_fused_vs_reference(self, rng):
+        b, s, h, d = 2, 16, 4, 128
+        x = _x(rng, (b, s, h, d))
+        cos, sin = ops.rope_cos_sin(s, d)
+        got = ops.fused_rope(x, cos, sin,
+                             implementation="pallas_interpret")
+        want = ops.rope_reference(x, cos, sin)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sbhd_layout(self, rng):
+        s, h, d = 12, 2, 128
+        x = _x(rng, (s, h, d))
+        cos, sin = ops.rope_cos_sin(s, d)
+        got = ops.fused_rope(x, cos, sin,
+                             implementation="pallas_interpret")
+        want = ops.rope_reference(x, cos, sin)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_partial_rotary_fallback(self, rng):
+        # rot_dim < head_dim → tail passthrough (XLA path; 64 unaligned)
+        b, s, h, d = 1, 8, 2, 128
+        x = _x(rng, (b, s, h, d))
+        cos, sin = ops.rope_cos_sin(s, 64)
+        got = ops.fused_rope(x, cos, sin, implementation="xla")
+        np.testing.assert_allclose(np.asarray(got[..., 64:]),
+                                   np.asarray(x[..., 64:]))
+
+    def test_backward_rotation_transpose(self, rng):
+        b, s, h, d = 1, 8, 2, 128
+        x = _x(rng, (b, s, h, d))
+        cos, sin = ops.rope_cos_sin(s, d)
+
+        def f_fused(x):
+            return jnp.sum(jnp.cos(ops.fused_rope(
+                x, cos, sin, implementation="pallas_interpret")))
+
+        def f_ref(x):
+            return jnp.sum(jnp.cos(ops.rope_reference(x, cos, sin)))
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(f_fused)(x)),
+            np.asarray(jax.grad(f_ref)(x)), rtol=1e-4, atol=1e-5)
+
+    def test_norm_preserved(self, rng):
+        # rotation preserves per-pair norms
+        b, s, h, d = 1, 4, 1, 128
+        x = _x(rng, (b, s, h, d))
+        cos, sin = ops.rope_cos_sin(s, d)
+        y = ops.fused_rope(x, cos, sin, implementation="pallas_interpret")
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+
+
+class TestXentropy:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_vs_torch(self, rng, smoothing):
+        n, v = 16, 1000
+        logits_np = rng.normal(size=(n, v)).astype(np.float32) * 3
+        labels_np = rng.integers(0, v, size=(n,))
+        got = ops.softmax_cross_entropy(
+            jnp.asarray(logits_np), jnp.asarray(labels_np), smoothing)
+        want = torch.nn.functional.cross_entropy(
+            torch.tensor(logits_np), torch.tensor(labels_np),
+            label_smoothing=smoothing, reduction="none")
+        np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("smoothing", [0.0, 0.2])
+    def test_grads_vs_torch(self, rng, smoothing):
+        n, v = 8, 257
+        logits_np = rng.normal(size=(n, v)).astype(np.float32)
+        labels_np = rng.integers(0, v, size=(n,))
+
+        def f(l):
+            return jnp.mean(ops.softmax_cross_entropy(
+                l, jnp.asarray(labels_np), smoothing))
+
+        dl = jax.grad(f)(jnp.asarray(logits_np))
+        lt = torch.tensor(logits_np, requires_grad=True)
+        torch.nn.functional.cross_entropy(
+            lt, torch.tensor(labels_np),
+            label_smoothing=smoothing).backward()
+        np.testing.assert_allclose(np.asarray(dl), lt.grad.numpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_ignore_index(self, rng):
+        n, v = 6, 50
+        logits = _x(rng, (n, v))
+        labels = jnp.asarray([1, 2, 0, 0, 3, 4])
+        loss = ops.softmax_cross_entropy(logits, labels, 0.0, 0)
+        assert float(loss[2]) == 0.0 and float(loss[3]) == 0.0
+        # grads of ignored rows are zero
+        g = jax.grad(lambda l: jnp.sum(
+            ops.softmax_cross_entropy(l, labels, 0.0, 0)))(logits)
+        np.testing.assert_array_equal(np.asarray(g[2]), 0.0)
+
+    def test_half_input_fp32_loss(self, rng):
+        logits = _x(rng, (4, 128), jnp.bfloat16)
+        labels = jnp.asarray([0, 1, 2, 3])
+        loss = ops.softmax_cross_entropy(logits, labels)
+        assert loss.dtype == jnp.float32  # half_to_float parity
+
+
+class TestMLP:
+    def test_fused_dense_vs_torch_linear(self, rng):
+        x_np = rng.normal(size=(4, 32)).astype(np.float32)
+        w_np = rng.normal(size=(32, 16)).astype(np.float32)
+        b_np = rng.normal(size=(16,)).astype(np.float32)
+        got = ops.fused_dense(jnp.asarray(x_np), jnp.asarray(w_np),
+                              jnp.asarray(b_np))
+        want = torch.nn.functional.linear(
+            torch.tensor(x_np), torch.tensor(w_np).T, torch.tensor(b_np))
+        np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mlp_module_matches_reference_semantics(self, rng):
+        # activation on all but last layer, like apex.mlp.MLP
+        m = ops.MLP(mlp_sizes=(64, 32, 8), activation="relu")
+        x = _x(rng, (4, 16))
+        params = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(params, x)
+        assert y.shape == (4, 8)
+        # last layer linear → output can be negative
+        assert float(jnp.min(y)) < 0
+
+    def test_dense_gelu_dense(self, rng):
+        m = ops.FusedDenseGeluDense(intermediate_features=64,
+                                    out_features=16)
+        x = _x(rng, (4, 16))
+        params = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(params, x)
+        assert y.shape == (4, 16)
+
+    def test_bf16_compute_fp32_accumulate(self, rng):
+        x = _x(rng, (8, 128), jnp.bfloat16)
+        w = _x(rng, (128, 64), jnp.bfloat16)
+        y = ops.fused_dense(x, w)
+        assert y.dtype == jnp.bfloat16
+
+
+class TestGroupNorm:
+    def test_vs_torch(self, rng):
+        n, hh, ww, c = 2, 4, 4, 32
+        x_np = rng.normal(size=(n, hh, ww, c)).astype(np.float32)
+        w_np = rng.normal(size=(c,)).astype(np.float32)
+        b_np = rng.normal(size=(c,)).astype(np.float32)
+        got = ops.group_norm(jnp.asarray(x_np), 8, jnp.asarray(w_np),
+                             jnp.asarray(b_np))
+        # torch is NCHW
+        want = torch.nn.functional.group_norm(
+            torch.tensor(x_np).permute(0, 3, 1, 2), 8,
+            torch.tensor(w_np), torch.tensor(b_np)
+        ).permute(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_silu_fusion(self, rng):
+        x = _x(rng, (2, 4, 4, 16))
+        y = ops.group_norm(x, 4, act="silu")
+        base = ops.group_norm(x, 4)
+        np.testing.assert_allclose(
+            np.asarray(y),
+            np.asarray(base) * (1 / (1 + np.exp(-np.asarray(base)))),
+            rtol=1e-5, atol=1e-6)
+
+    def test_module(self, rng):
+        m = ops.GroupNorm(num_groups=4, act="silu")
+        x = _x(rng, (2, 3, 3, 16))
+        params = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(params, x)
+        assert y.shape == x.shape
+
+    def test_bad_groups_raises(self, rng):
+        with pytest.raises(ValueError):
+            ops.group_norm(_x(rng, (1, 2, 2, 10)), 3)

@@ -1,0 +1,194 @@
+"""SyncBatchNorm and DDP tests on the virtual CPU mesh — the hermetic
+version of the reference's ``tests/distributed/synced_batchnorm`` and
+``tests/distributed/DDP`` two-GPU suites (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.core import mesh as mesh_lib
+from apex_tpu import parallel as apx_parallel
+from apex_tpu.parallel import (
+    SyncBatchNorm, sync_batch_norm_stats, convert_syncbn_model,
+    DistributedDataParallel, zero_param_specs,
+)
+
+
+def shard_map(fn, mesh, in_specs, out_specs, **kw):
+    kw.setdefault("check_vma", False)
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **kw)
+
+
+@pytest.fixture
+def dp_mesh():
+    m = mesh_lib.initialize_mesh(data_parallel_size=8)
+    yield m
+    mesh_lib.destroy_mesh()
+
+
+class TestSyncBatchNorm:
+    def test_stats_match_global_batch(self, dp_mesh, rng):
+        # stats over 8 shards == stats over the concatenated batch
+        x = jnp.asarray(rng.normal(size=(16, 4, 4, 8)), jnp.float32)
+
+        f = shard_map(
+            lambda xs: sync_batch_norm_stats(
+                xs, ("data",), reduce_dims=(0, 1, 2)),
+            dp_mesh, (P("data"),), (P(), P()))
+        mean, var = f(x)
+        want_mean = np.mean(np.asarray(x), axis=(0, 1, 2))
+        want_var = np.var(np.asarray(x), axis=(0, 1, 2))
+        np.testing.assert_allclose(np.asarray(mean), want_mean, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(var), want_var,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_module_matches_single_device_bn(self, dp_mesh, rng):
+        # the reference's canonical test: 2-process SyncBN == 1-process BN
+        x = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+        sbn = SyncBatchNorm(use_running_average=False)
+        variables = sbn.init(jax.random.PRNGKey(0), x)
+
+        def fwd(xs):
+            y, _ = sbn.apply(variables, xs, mutable=["batch_stats"])
+            return y
+
+        y_sharded = shard_map(fwd, dp_mesh, (P("data"),),
+                              P("data"))(x)
+        bn = nn.BatchNorm(use_running_average=False, momentum=0.9)
+        bn_vars = bn.init(jax.random.PRNGKey(0), x)
+        y_single, _ = bn.apply(bn_vars, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(y_sharded),
+                                   np.asarray(y_single),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gradients_cross_device_terms(self, dp_mesh, rng):
+        # grad wrt x must include the cross-shard stat terms: compare
+        # sharded-grad vs single-device autodiff of plain BN
+        x = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+        sbn = SyncBatchNorm(use_running_average=False)
+        variables = sbn.init(jax.random.PRNGKey(0), x)
+
+        def g_sharded(xs):
+            def loss(xs):
+                y, _ = sbn.apply(variables, xs, mutable=["batch_stats"])
+                return jnp.sum(y ** 3)  # nonlinear so stat grads matter
+            return jax.grad(loss)(xs)
+
+        gs = shard_map(g_sharded, dp_mesh, (P("data"),), P("data"))(x)
+
+        bn = nn.BatchNorm(use_running_average=False)
+        bn_vars = bn.init(jax.random.PRNGKey(0), x)
+
+        def loss_single(x):
+            y, _ = bn.apply(bn_vars, x, mutable=["batch_stats"])
+            return jnp.sum(y ** 3)
+
+        # NOTE: per-shard grad omits cross-shard x-terms of OTHER shards'
+        # losses; but loss is a sum over shards and grads add — with the
+        # shared global stats the sharded grad equals the global grad.
+        gd = jax.grad(loss_single)(x)
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(gd),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_running_stats_update(self, dp_mesh, rng):
+        x = jnp.asarray(rng.normal(size=(16, 8)) + 3.0, jnp.float32)
+        sbn = SyncBatchNorm(use_running_average=False, momentum=0.5)
+        variables = sbn.init(jax.random.PRNGKey(0), x)
+
+        def fwd(xs):
+            _, upd = sbn.apply(variables, xs, mutable=["batch_stats"])
+            return upd["batch_stats"]["mean"], upd["batch_stats"]["var"]
+
+        mean, var = shard_map(fwd, dp_mesh, (P("data"),), (P(), P()))(x)
+        want = 0.5 * 0.0 + 0.5 * np.mean(np.asarray(x), axis=0)
+        np.testing.assert_allclose(np.asarray(mean), want, rtol=1e-4)
+
+    def test_eval_mode_uses_running(self, rng):
+        x = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+        sbn = SyncBatchNorm(use_running_average=True)
+        variables = sbn.init(jax.random.PRNGKey(0), x)
+        y = sbn.apply(variables, x)
+        # running stats are (0, 1) at init → y == scale*x + bias == x
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x),
+                                   rtol=1e-5)
+
+    def test_convert_syncbn_model(self):
+        class Net(nn.Module):
+            bn: nn.Module = None
+
+            @nn.compact
+            def __call__(self, x):
+                return self.bn(x)
+
+        net = Net(bn=nn.BatchNorm(use_running_average=False,
+                                  momentum=0.8))
+        converted = convert_syncbn_model(net)
+        assert isinstance(converted.bn, SyncBatchNorm)
+        assert converted.bn.momentum == 0.8
+
+    def test_local_fallback_no_mesh(self, rng):
+        # outside shard_map: behaves as plain BN (reference python impl
+        # fallback path)
+        x = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+        sbn = SyncBatchNorm(use_running_average=False)
+        variables = sbn.init(jax.random.PRNGKey(0), x)
+        y, _ = sbn.apply(variables, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.mean(np.asarray(y), axis=0), 0.0,
+                                   atol=1e-5)
+
+
+class TestDDP:
+    def test_sharded_training_matches_single_device(self, dp_mesh, rng):
+        # end-to-end: DP training step over 8 shards == single-device
+        # step on the full batch (apex DDP's correctness contract)
+        import optax
+        from apex_tpu import optim as ao
+
+        x = jnp.asarray(rng.normal(size=(32, 8)), jnp.float32)
+        y = jnp.asarray(rng.normal(size=(32, 2)), jnp.float32)
+        params = {"w": jnp.asarray(rng.normal(size=(8, 2)), jnp.float32),
+                  "b": jnp.zeros((2,), jnp.float32)}
+        tx = ao.fused_sgd(0.1, momentum=0.9)
+        opt_state = tx.init(params)
+
+        def local_loss(p, xs, ys):
+            pred = xs @ p["w"] + p["b"]
+            return jnp.mean((pred - ys) ** 2)
+
+        def dp_step(p, s, xs, ys):
+            g = jax.grad(local_loss)(p, xs, ys)
+            g = apx_parallel.all_reduce_mean_grads(g, "data")
+            updates, s2 = tx.update(g, s, p)
+            import optax as _o
+            return _o.apply_updates(p, updates), s2
+
+        f = shard_map(dp_step, dp_mesh,
+                      (P(), P(), P("data"), P("data")), (P(), P()))
+        p_dp, _ = f(params, opt_state, x, y)
+
+        g_full = jax.grad(local_loss)(params, x, y)
+        updates, _ = tx.update(g_full, opt_state, params)
+        import optax as _o
+        p_single = _o.apply_updates(params, updates)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p_dp[k]),
+                                       np.asarray(p_single[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_ddp_wrapper_placement(self, dp_mesh, rng):
+        ddp = DistributedDataParallel(dp_mesh)
+        params = {"w": jnp.ones((4, 4))}
+        p = ddp.replicate(params)
+        batch = ddp.shard({"x": jnp.ones((16, 4))})
+        assert p["w"].sharding.is_fully_replicated
+        assert not batch["x"].sharding.is_fully_replicated
+
+    def test_zero_param_specs(self, dp_mesh):
+        params = {"w": jnp.ones((16, 4)), "scalar": jnp.ones(())}
+        specs = zero_param_specs(params, axis="data", mesh=dp_mesh)
+        assert specs["w"] == P("data", None)
+        assert specs["scalar"] == P()

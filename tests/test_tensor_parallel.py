@@ -1,0 +1,236 @@
+"""Hermetic tensor-parallel tests on an 8-virtual-device CPU mesh —
+strictly better than the reference's >=2-real-GPU requirement
+(SURVEY.md §4): TP layer math vs dense reference, mapping dualities,
+vocab-parallel CE vs full-vocab CE, sequence parallelism."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu.core import mesh as mesh_lib
+from apex_tpu.transformer import (
+    mappings,
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+    vocab_parallel_cross_entropy,
+    parallel_state,
+)
+from apex_tpu import ops
+
+
+@pytest.fixture
+def tp_mesh():
+    m = mesh_lib.initialize_mesh(tensor_model_parallel_size=4,
+                                 data_parallel_size=2)
+    yield m
+    mesh_lib.destroy_mesh()
+
+
+def shard_map(fn, mesh, in_specs, out_specs, **kw):
+    kw.setdefault("check_vma", False)
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **kw)
+
+
+def _smap(mesh, fn, in_specs, out_specs):
+    return shard_map(fn, mesh, in_specs, out_specs)
+
+
+class TestMappings:
+    def test_copy_and_reduce_duality(self, tp_mesh, rng):
+        x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+
+        # f: identity fwd
+        f = _smap(tp_mesh, lambda x: mappings.copy_to_tensor_parallel_region(x),
+                  (P(),), P())
+        np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x))
+
+        # f bwd: grad of sum over all shards' use = psum of ones = tp_size
+        def loss(x):
+            y = _smap(tp_mesh,
+                      lambda x: mappings.copy_to_tensor_parallel_region(x),
+                      (P(),), P())(x)
+            return jnp.sum(y)
+        g = jax.grad(loss)(x)
+        # single logical consumer -> grad == tp_size (psum over 4 ranks)
+        np.testing.assert_allclose(np.asarray(g), 4.0)
+
+    def test_reduce_from_sums_partials(self, tp_mesh):
+        # each shard contributes its rank; psum = 0+1+2+3 = 6
+        def body():
+            r = lax.axis_index("tensor").astype(jnp.float32)
+            return mappings.reduce_from_tensor_parallel_region(
+                jnp.full((2, 2), r))
+        f = _smap(tp_mesh, body, (), P())
+        np.testing.assert_allclose(np.asarray(f()), 6.0)
+
+    def test_scatter_gather_roundtrip(self, tp_mesh, rng):
+        x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+
+        def body(x):
+            s = mappings.scatter_to_tensor_parallel_region(x)
+            return mappings.gather_from_tensor_parallel_region(s)
+        f = _smap(tp_mesh, body, (P(),), P())
+        np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x))
+
+    def test_sequence_parallel_pair(self, tp_mesh, rng):
+        # gather(seq) then reduce_scatter(seq) over partials == psum/g…
+        x = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+
+        def body(xs):
+            full = mappings.gather_from_sequence_parallel_region(xs, dim=0)
+            return mappings.reduce_scatter_to_sequence_parallel_region(
+                full, dim=0)
+        f = _smap(tp_mesh, body, (P("tensor", None),), P("tensor", None))
+        # gather makes (8,4) full on each rank; reduce-scatter sums the
+        # 4 identical copies and hands back this rank's slice → 4*x
+        np.testing.assert_allclose(np.asarray(f(x)), 4 * np.asarray(x),
+                                   rtol=1e-6)
+
+
+class TestTPLinearFunctions:
+    def test_column_then_row_matches_dense(self, tp_mesh, rng):
+        b, din, dmid, dout = 4, 16, 32, 24
+        x = jnp.asarray(rng.normal(size=(b, din)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(din, dmid)), jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(dmid, dout)), jnp.float32)
+
+        def block(x, w1s, w2s):
+            h = column_parallel_linear(x, w1s)
+            h = jax.nn.relu(h)
+            return row_parallel_linear(h, w2s)
+
+        f = _smap(tp_mesh, block,
+                  (P(), P(None, "tensor"), P("tensor", None)), P())
+        got = f(x, w1, w2)
+        want = jax.nn.relu(x @ w1) @ w2
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_dense(self, tp_mesh, rng):
+        # canonical shard_map TP training pattern: the per-shard loss is
+        # the FULL loss (output replicated after reduce_from); grads are
+        # taken inside the region, and the mappings' custom VJPs insert
+        # the collectives (copy_to bwd = psum) — Megatron semantics.
+        b, din, dmid = 4, 8, 16
+        x = jnp.asarray(rng.normal(size=(b, din)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(din, dmid)), jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(dmid, din)), jnp.float32)
+
+        def per_shard_grads(x, w1s, w2s):
+            def loss_fn(w1s, w2s):
+                h = jax.nn.relu(column_parallel_linear(x, w1s))
+                y = row_parallel_linear(h, w2s)
+                return jnp.sum(y ** 2)
+            return jax.grad(loss_fn, argnums=(0, 1))(w1s, w2s)
+
+        f = _smap(tp_mesh, per_shard_grads,
+                  (P(), P(None, "tensor"), P("tensor", None)),
+                  (P(None, "tensor"), P("tensor", None)))
+        g_tp = f(x, w1, w2)
+
+        def dense_loss(w1, w2):
+            return jnp.sum((jax.nn.relu(x @ w1) @ w2) ** 2)
+
+        g_d = jax.grad(dense_loss, argnums=(0, 1))(w1, w2)
+        for a, b2 in zip(g_tp, g_d):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_sequence_parallel_block_matches_dense(self, tp_mesh, rng):
+        # SP: activations sharded along sequence between blocks
+        s, din, dmid = 8, 16, 32
+        x = jnp.asarray(rng.normal(size=(s, din)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(din, dmid)), jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(dmid, din)), jnp.float32)
+
+        def block(xs, w1s, w2s):
+            h = column_parallel_linear(xs, w1s, sequence_parallel=True,
+                                       seq_dim=0)
+            h = jax.nn.relu(h)
+            return row_parallel_linear(h, w2s, sequence_parallel=True,
+                                       seq_dim=0)
+
+        f = _smap(tp_mesh, block,
+                  (P("tensor", None), P(None, "tensor"),
+                   P("tensor", None)),
+                  P("tensor", None))
+        got = f(x, w1, w2)
+        want = jax.nn.relu(x @ w1) @ w2
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestVocabParallel:
+    def test_embedding_matches_dense(self, tp_mesh, rng):
+        vocab, dim = 64, 8
+        table = jnp.asarray(rng.normal(size=(vocab, dim)), jnp.float32)
+        ids = jnp.asarray(rng.integers(0, vocab, size=(4, 6)))
+        f = _smap(tp_mesh,
+                  lambda i, t: vocab_parallel_embedding(i, t),
+                  (P(), P("tensor", None)), P())
+        got = f(ids, table)
+        want = jnp.take(table, ids, axis=0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_cross_entropy_matches_full_vocab(self, tp_mesh, rng,
+                                              smoothing):
+        n, vocab = 8, 64
+        logits = jnp.asarray(rng.normal(size=(n, vocab)), jnp.float32) * 3
+        labels = jnp.asarray(rng.integers(0, vocab, size=(n,)))
+        f = _smap(tp_mesh,
+                  lambda l, t: vocab_parallel_cross_entropy(
+                      l, t, smoothing=smoothing),
+                  (P(None, "tensor"), P()), P())
+        got = f(logits, labels)
+        want = ops.softmax_cross_entropy(logits, labels, smoothing)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_cross_entropy_grads_match(self, tp_mesh, rng):
+        n, vocab = 4, 32
+        logits = jnp.asarray(rng.normal(size=(n, vocab)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, vocab, size=(n,)))
+
+        def per_shard_grad(l, t):
+            return jax.grad(lambda l: jnp.mean(
+                vocab_parallel_cross_entropy(l, t)))(l)
+
+        g_tp = _smap(tp_mesh, per_shard_grad,
+                     (P(None, "tensor"), P()), P(None, "tensor"))(
+            logits, labels)
+
+        def full_loss(l):
+            return jnp.mean(ops.softmax_cross_entropy(l, labels))
+
+        np.testing.assert_allclose(
+            np.asarray(g_tp), np.asarray(jax.grad(full_loss)(logits)),
+            rtol=1e-5, atol=1e-6)
+
+
+class TestParallelState:
+    def test_world_sizes(self, tp_mesh):
+        assert parallel_state.get_tensor_model_parallel_world_size() == 4
+        assert parallel_state.get_data_parallel_world_size() == 2
+        assert parallel_state.get_pipeline_model_parallel_world_size() == 1
+        assert parallel_state.model_parallel_is_initialized()
+
+    def test_initialize_signature_parity(self):
+        m = parallel_state.initialize_model_parallel(2, 2)
+        assert m.shape["tensor"] == 2 and m.shape["pipe"] == 2
+        parallel_state.destroy_model_parallel()
+        assert not parallel_state.model_parallel_is_initialized()
+
+    def test_ranks_inside_shard_map(self, tp_mesh):
+        f = shard_map(
+            lambda: parallel_state.get_tensor_model_parallel_rank()[None],
+            mesh=tp_mesh, in_specs=(), out_specs=P("tensor"))
+        ranks = np.asarray(f())
+        assert sorted(ranks.tolist()) == [0, 1, 2, 3]
