@@ -13,7 +13,8 @@ import os
 # Force CPU even if the ambient environment selects a TPU platform
 # (e.g. JAX_PLATFORMS=axon): the unit suite must be hermetic and fast.
 # Set APEX_TPU_TEST_PLATFORM=tpu to run kernel tests on real hardware.
-os.environ["JAX_PLATFORMS"] = os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu")
+_platform = os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,6 +23,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# A sitecustomize hook may have imported jax (registering a TPU plugin)
+# before this conftest ran, making the env var above a no-op.  Setting
+# the config directly still works as long as no backend has been used.
+jax.config.update("jax_platforms", _platform)
+assert jax.default_backend() == _platform.split(",")[0], (
+    f"test suite must run on {_platform}, got {jax.default_backend()}")
 
 
 @pytest.fixture
