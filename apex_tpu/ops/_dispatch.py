@@ -25,7 +25,7 @@ from typing import Optional
 
 import jax
 
-__all__ = ["resolve_impl", "use_interpret"]
+__all__ = ["resolve_impl", "pick_block_rows"]
 
 _VALID = ("auto", "pallas", "pallas_interpret", "xla")
 
@@ -49,5 +49,11 @@ def resolve_impl(implementation: Optional[str], *,
     return impl
 
 
-def use_interpret(impl: str) -> bool:
-    return impl == "pallas_interpret"
+def pick_block_rows(n_rows: int, width: int) -> int:
+    """Rows per grid step for row-wise kernels (LN/softmax): keep the
+    fp32 x-block ≲ 2 MB of VMEM, ≥ 8 rows, multiple of 8 (fp32 sublane).
+    """
+    budget = (2 * 1024 * 1024) // max(1, width * 4)
+    br = max(8, min(256, budget))
+    br = (br // 8) * 8
+    return max(8, min(br, max(8, n_rows)))

@@ -49,6 +49,8 @@ def attention_reference(q, k, v, *, causal: bool = False,
     """Eager attention: softmax(q·kᵀ·scale + bias [causal]) · v.
 
     Shapes: q (b, sq, h, d); k/v (b, sk, hk, d) with h % hk == 0.
+    Query rows with no visible key (causal with sq > sk) output zeros —
+    the flash-attention convention, matched by the Pallas kernel.
     """
     b, sq, h, d = q.shape
     hk = k.shape[2]
@@ -65,8 +67,11 @@ def attention_reference(q, k, v, *, causal: bool = False,
         sk = k.shape[1]
         q_idx = jnp.arange(sq)[:, None]
         k_idx = jnp.arange(sk)[None, :]
-        s = jnp.where(k_idx > q_idx + (sk - sq), _NEG_INF, s)
-    p = jax.nn.softmax(s, axis=-1)
+        masked = k_idx > q_idx + (sk - sq)
+        p = jax.nn.softmax(jnp.where(masked, _NEG_INF, s), axis=-1)
+        p = jnp.where(masked, 0.0, p)              # zero fully-masked rows
+    else:
+        p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return o.astype(q.dtype)
 
@@ -99,15 +104,21 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        masked = None
         if causal:
             q_pos = i * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
-            s = jnp.where(k_pos > q_pos + (sk - sq), _NEG_INF, s)
+            masked = k_pos > q_pos + (sk - sq)
+            s = jnp.where(masked, _NEG_INF, s)
         m_prev = m_ref[:]                          # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)                     # (bq, bk)
+        if masked is not None:
+            # fully-masked rows have m_new == _NEG_INF, making
+            # exp(s - m_new) == 1; zero them so such rows output 0
+            p = jnp.where(masked, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)            # (bq, 1)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -123,7 +134,7 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
 
 
-def _run_fa_fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
+def _run_fa_fwd(q3, k3, v3, scale, causal, rep, bq, bk, interpret):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     grid = (bh, sq // bq, sk // bk)
@@ -136,9 +147,12 @@ def _run_fa_fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+            # GQA: `rep` consecutive q heads share one kv head — the kv
+            # BlockSpecs index b // rep, so kv is never materialized
+            # per-q-head in HBM (no jnp.repeat)
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -188,13 +202,15 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                       # (bq, bk)
         if causal:
             q_pos = i * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
-            s = jnp.where(k_pos > q_pos + (sk - sq), _NEG_INF, s)
-        p = jnp.exp(s - lse)                       # (bq, bk)
+            # zero rather than -inf: fully-masked rows (lse == -inf)
+            # would otherwise get exp(-inf - -inf) == 1
+            p = jnp.where(k_pos > q_pos + (sk - sq), 0.0, p)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bq, bk)
@@ -233,13 +249,15 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                       # (bq, bk)
         if causal:
             q_pos = i * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
-            s = jnp.where(k_pos > q_pos + (sk - sq), _NEG_INF, s)
-        p = jnp.exp(s - lse)                       # (bq, bk)
+            # zero rather than -inf: fully-masked rows (lse == -inf)
+            # would otherwise get exp(-inf - -inf) == 1
+            p = jnp.where(k_pos > q_pos + (sk - sq), 0.0, p)
         # dv += pᵀ @ do
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -259,7 +277,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _run_fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk,
+def _run_fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, rep, bq, bk,
                 interpret):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
@@ -275,9 +293,9 @@ def _run_fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
@@ -296,15 +314,19 @@ def _run_fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk,
     dkv_kernel = functools.partial(
         _fa_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
         sq_blocks=sq // bq, sq=sq, sk=sk)
+    # dk/dv are computed per *q* head (grid axis 0 = b*h) so each output
+    # block is owned by one grid lane; for GQA the rep-sized head groups
+    # are summed afterwards (cheap, fp32) instead of making the kernel
+    # revisit shared kv output blocks.
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, sk // bk, sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b // rep, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b // rep, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
                          memory_space=pltpu.VMEM),
@@ -320,8 +342,12 @@ def _run_fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+            # fp32 only when a cross-head group sum follows (rep > 1);
+            # otherwise write the kv dtype directly (half the HBM bytes)
+            jax.ShapeDtypeStruct(
+                (bh, sk, d), jnp.float32 if rep > 1 else k3.dtype),
+            jax.ShapeDtypeStruct(
+                (bh, sk, d), jnp.float32 if rep > 1 else v3.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -329,27 +355,31 @@ def _run_fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk,
         ],
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
-    return dq, dk, dv
+    if rep > 1:
+        dk = dk.reshape(bh // rep, rep, sk, d).sum(axis=1)
+        dv = dv.reshape(bh // rep, rep, sk, d).sum(axis=1)
+    return dq, dk.astype(k3.dtype), dv.astype(v3.dtype)
 
 
 # --------------------------------------------------------------------- #
 # custom VJP over (b*h, s, d) arrays
 # --------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _fa_pallas(q3, k3, v3, scale, causal, bq, bk, interpret):
-    o, _ = _run_fa_fwd(q3, k3, v3, scale, causal, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _fa_pallas(q3, k3, v3, scale, causal, rep, bq, bk, interpret):
+    o, _ = _run_fa_fwd(q3, k3, v3, scale, causal, rep, bq, bk, interpret)
     return o
 
 
-def _fa_pallas_fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
-    o, lse = _run_fa_fwd(q3, k3, v3, scale, causal, bq, bk, interpret)
+def _fa_pallas_fwd(q3, k3, v3, scale, causal, rep, bq, bk, interpret):
+    o, lse = _run_fa_fwd(q3, k3, v3, scale, causal, rep, bq, bk,
+                         interpret)
     return o, (q3, k3, v3, o, lse)
 
 
-def _fa_pallas_bwd(scale, causal, bq, bk, interpret, res, do):
+def _fa_pallas_bwd(scale, causal, rep, bq, bk, interpret, res, do):
     q3, k3, v3, o, lse = res
     dq, dk, dv = _run_fa_bwd(q3, k3, v3, o, lse, do, scale, causal,
-                             bq, bk, interpret)
+                             rep, bq, bk, interpret)
     return dq, dk, dv
 
 
@@ -373,6 +403,9 @@ def fused_attention(q, k, v, *, causal: bool = False,
     """
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
+    if h % hk:
+        raise ValueError(
+            f"num_kv_heads ({hk}) must divide num_heads ({h})")
     scale = (d ** -0.5) if scale is None else float(scale)
     bq = min(block_q, sq)
     bk = min(block_k, sk)
@@ -387,12 +420,11 @@ def fused_attention(q, k, v, *, causal: bool = False,
         return attention_reference(q, k, v, causal=causal, scale=scale,
                                    bias=bias)
     interpret = impl == "pallas_interpret"
-    if hk != h:                                    # GQA: expand kv heads
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
-    # (b, s, h, d) -> (b*h, s, d)
+    # (b, s, h, d) -> (b*h, s, d); GQA kv stays at (b*hk, s, d) — the
+    # kernels' kv BlockSpecs map rep consecutive q heads to one kv head
     q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    k3 = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    v3 = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    o3 = _fa_pallas(q3, k3, v3, scale, bool(causal), bq, bk, interpret)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
+    o3 = _fa_pallas(q3, k3, v3, scale, bool(causal), h // hk, bq, bk,
+                    interpret)
     return o3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

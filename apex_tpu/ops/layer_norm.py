@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops._dispatch import resolve_impl
+from apex_tpu.ops._dispatch import pick_block_rows, resolve_impl
 
 __all__ = [
     "fused_layer_norm",
@@ -71,15 +71,6 @@ def rms_norm_reference(x, weight=None, eps: float = 1e-5):
 # --------------------------------------------------------------------- #
 # Pallas kernels
 # --------------------------------------------------------------------- #
-def _pick_block_rows(n_rows: int, hidden: int) -> int:
-    """Rows per grid step: keep x-block ≲ 2 MB of VMEM fp32, ≥ 8 rows."""
-    budget = (2 * 1024 * 1024) // max(1, hidden * 4)
-    br = max(8, min(256, budget))
-    # round down to a multiple of 8 (fp32 sublane)
-    br = (br // 8) * 8
-    return max(8, min(br, max(8, n_rows)))
-
-
 def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mu_ref, rs_ref, *,
                    eps: float, rms: bool):
     x = x_ref[:].astype(jnp.float32)
@@ -121,7 +112,7 @@ def _ln_bwd_dx_kernel(dy_ref, x_ref, w_ref, mu_ref, rs_ref, dx_ref, *,
 
 def _run_ln_fwd(x2d, w2d, b2d, eps, rms, interpret):
     n, h = x2d.shape
-    br = _pick_block_rows(n, h)
+    br = pick_block_rows(n, h)
     grid = (pl.cdiv(n, br),)
     kernel = functools.partial(_ln_fwd_kernel, eps=eps, rms=rms)
     in_specs = [
@@ -165,7 +156,7 @@ def _ln_fwd_kernel_nobias(x_ref, w_ref, y_ref, mu_ref, rs_ref, *,
 
 def _run_ln_bwd_dx(dy2d, x2d, w2d, mu, rstd, rms, interpret):
     n, h = x2d.shape
-    br = _pick_block_rows(n, h)
+    br = pick_block_rows(n, h)
     grid = (pl.cdiv(n, br),)
     kernel = functools.partial(_ln_bwd_dx_kernel, rms=rms)
     dx = pl.pallas_call(

@@ -22,10 +22,39 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from apex_tpu.ops.attention import fused_attention
+from apex_tpu.ops.attention import _NEG_INF, fused_attention
 from apex_tpu.ops.layer_norm import fused_layer_norm
 
 __all__ = ["SelfMultiheadAttn", "EncdecMultiheadAttn"]
+
+
+def _attention_bias(mask, key_padding_mask):
+    """Combine ``mask`` / ``key_padding_mask`` into an additive bias.
+
+    Torch/apex MHA conventions: a *boolean* mask marks masked positions
+    with ``True`` and becomes ``-inf`` bias; a *float* mask is already an
+    additive bias.  ``mask`` is ``(seq_q, seq_k)`` or
+    ``(batch, seq_q, seq_k)`` (broadcast over heads);
+    ``key_padding_mask`` is ``(batch, seq_k)``.
+    """
+    def to_bias(m):
+        m = jnp.asarray(m)
+        if m.dtype == jnp.bool_:
+            return jnp.where(m, _NEG_INF, 0.0).astype(jnp.float32)
+        return m.astype(jnp.float32)
+
+    bias = None
+    if mask is not None:
+        m = jnp.asarray(mask)
+        if m.ndim == 2:                  # (sq, sk)
+            m = m[None, None, :, :]
+        elif m.ndim == 3:                # (b, sq, sk)
+            m = m[:, None, :, :]
+        bias = to_bias(m)
+    if key_padding_mask is not None:
+        kp = to_bias(jnp.asarray(key_padding_mask)[:, None, None, :])
+        bias = kp if bias is None else bias + kp
+    return bias
 
 
 class SelfMultiheadAttn(nn.Module):
@@ -46,9 +75,12 @@ class SelfMultiheadAttn(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, *, mask=None, deterministic: bool = True):
+    def __call__(self, x, *, mask=None, key_padding_mask=None,
+                 deterministic: bool = True):
         if self.embed_dim % self.num_heads:
-            raise ValueError("embed_dim must divide num_heads")
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must divide embed_dim "
+                f"({self.embed_dim})")
         d = self.embed_dim // self.num_heads
         dtype = self.dtype or x.dtype
         residual = x
@@ -64,7 +96,8 @@ class SelfMultiheadAttn(nn.Module):
             dtype=dtype, param_dtype=self.param_dtype, name="qkv_proj")(x)
         q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :],
                    qkv[..., 2, :, :])
-        o = fused_attention(q, k, v, causal=self.causal, bias=mask)
+        o = fused_attention(q, k, v, causal=self.causal,
+                            bias=_attention_bias(mask, key_padding_mask))
         if self.dropout > 0.0 and not deterministic:
             o = nn.Dropout(rate=self.dropout)(o, deterministic=False)
         o = o.reshape(*o.shape[:-2], self.embed_dim)
@@ -89,7 +122,11 @@ class EncdecMultiheadAttn(nn.Module):
 
     @nn.compact
     def __call__(self, query, key_value, *, mask=None,
-                 deterministic: bool = True):
+                 key_padding_mask=None, deterministic: bool = True):
+        if self.embed_dim % self.num_heads:
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must divide embed_dim "
+                f"({self.embed_dim})")
         d = self.embed_dim // self.num_heads
         dtype = self.dtype or query.dtype
         residual = query
@@ -110,7 +147,8 @@ class EncdecMultiheadAttn(nn.Module):
                              param_dtype=self.param_dtype,
                              name="kv_proj")(key_value)
         k, v = kv[..., 0, :, :], kv[..., 1, :, :]
-        o = fused_attention(q, k, v, bias=mask)
+        o = fused_attention(q, k, v,
+                            bias=_attention_bias(mask, key_padding_mask))
         if self.dropout > 0.0 and not deterministic:
             o = nn.Dropout(rate=self.dropout)(o, deterministic=False)
         o = o.reshape(*o.shape[:-2], self.embed_dim)

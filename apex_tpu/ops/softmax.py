@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops._dispatch import resolve_impl
+from apex_tpu.ops._dispatch import pick_block_rows, resolve_impl
 
 __all__ = ["fused_scale_mask_softmax", "scale_mask_softmax_reference"]
 
@@ -63,13 +63,6 @@ def scale_mask_softmax_reference(x, mask=None, scale: float = 1.0,
 # --------------------------------------------------------------------- #
 # Pallas kernels
 # --------------------------------------------------------------------- #
-def _pick_block_rows(n_rows: int, width: int) -> int:
-    budget = (2 * 1024 * 1024) // max(1, width * 4)
-    br = max(8, min(256, budget))
-    br = (br // 8) * 8
-    return max(8, min(br, max(8, n_rows)))
-
-
 def _softmax_fwd_kernel(x_ref, y_ref, *, scale, causal, sq, sk, has_mask,
                         mask_ref=None):
     x = x_ref[:].astype(jnp.float32) * scale
@@ -98,7 +91,7 @@ def _softmax_bwd_kernel(dy_ref, y_ref, dx_ref, *, scale):
 
 def _run_softmax_fwd(x2d, mask2d, scale, causal, sq, sk, interpret):
     n, w = x2d.shape
-    br = _pick_block_rows(n, w)
+    br = pick_block_rows(n, w)
     grid = (pl.cdiv(n, br),)
     has_mask = mask2d is not None
     if has_mask:
@@ -135,7 +128,7 @@ def _run_softmax_fwd(x2d, mask2d, scale, causal, sq, sk, interpret):
 
 def _run_softmax_bwd(dy2d, y2d, scale, interpret):
     n, w = y2d.shape
-    br = _pick_block_rows(n, w)
+    br = pick_block_rows(n, w)
     grid = (pl.cdiv(n, br),)
     kernel = functools.partial(_softmax_bwd_kernel, scale=scale)
     return pl.pallas_call(

@@ -111,7 +111,7 @@ def _present_axes(axis_names):
         try:
             lax.axis_size(a)
             out.append(a)
-        except (NameError, KeyError, Exception):  # axis not bound
+        except (NameError, KeyError):  # axis not bound in this trace
             continue
     return tuple(out)
 

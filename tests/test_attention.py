@@ -61,6 +61,18 @@ class TestForward:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-4, atol=1e-4)
 
+    def test_long_query_causal(self, rng):
+        # sq > sk causal: leading query rows see no key at all; both
+        # paths must agree (zeros for fully-masked rows)
+        q, k, v = _qkv(rng, sq=256, sk=128)
+        got = fused_attention(q, k, v, causal=True,
+                              implementation="pallas_interpret")
+        want = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+        # the first sq - sk rows are fully masked -> exact zeros
+        np.testing.assert_array_equal(np.asarray(got)[:, :128], 0.0)
+
     def test_bf16(self, rng):
         q, k, v = _qkv(rng, dtype=jnp.bfloat16)
         got = fused_attention(q, k, v, causal=True,
@@ -135,6 +147,35 @@ class TestBackward:
         np.testing.assert_allclose(np.asarray(dv), tv.grad.numpy(),
                                    rtol=1e-3, atol=1e-3)
 
+    def test_gqa_grads(self, rng):
+        q, k, v = _qkv(rng, b=1, sq=128, sk=128, h=4, hk=2)
+
+        def f(impl):
+            def loss(q, k, v):
+                o = fused_attention(q, k, v, implementation=impl)
+                return jnp.sum(jnp.tanh(o))
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        for gf, gr, name in zip(f("pallas_interpret"), f("xla"), "qkv"):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                       rtol=1e-3, atol=1e-3,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_long_query_causal_grads(self, rng):
+        q, k, v = _qkv(rng, b=1, sq=256, sk=128, h=1)
+
+        def f(impl):
+            def loss(q, k, v):
+                o = fused_attention(q, k, v, causal=True,
+                                    implementation=impl)
+                return jnp.sum(jnp.tanh(o))
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        for gf, gr, name in zip(f("pallas_interpret"), f("xla"), "qkv"):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                       rtol=1e-3, atol=1e-3,
+                                       err_msg=f"d{name} mismatch")
+
     def test_rectangular_grads(self, rng):
         q, k, v = _qkv(rng, b=1, sq=128, sk=256, h=1)
 
@@ -164,6 +205,21 @@ class TestMultiheadAttnModules:
         leaves = jax.tree.leaves(g)
         assert all(bool(jnp.all(jnp.isfinite(l))) for l in leaves)
         assert any(float(jnp.max(jnp.abs(l))) > 0 for l in leaves)
+
+    def test_boolean_padding_mask_excludes_keys(self, rng):
+        # bool mask, True = masked (reference convention): masked keys
+        # must get ~zero attention, not a +1.0 additive bias
+        from apex_tpu.ops import SelfMultiheadAttn
+        m = SelfMultiheadAttn(embed_dim=256, num_heads=2)
+        x = jnp.asarray(rng.normal(size=(2, 16, 256)), jnp.float32)
+        params = m.init(jax.random.PRNGKey(0), x)
+        mask = jnp.zeros((2, 16), bool).at[:, 8:].set(True)
+        y_masked = m.apply(params, x, key_padding_mask=mask)
+        # output must equal attention over the first 8 tokens only
+        y_trunc = m.apply(params, x[:, :8])
+        np.testing.assert_allclose(np.asarray(y_masked[:, :8]),
+                                   np.asarray(y_trunc),
+                                   rtol=1e-5, atol=1e-5)
 
     def test_encdec_mha(self, rng):
         from apex_tpu.ops import EncdecMultiheadAttn
