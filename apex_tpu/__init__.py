@@ -41,6 +41,7 @@ from apex_tpu.core.mesh import (
 
 from apex_tpu import amp
 from apex_tpu import core
+from apex_tpu import models
 from apex_tpu import ops
 from apex_tpu import optim
 from apex_tpu import parallel

@@ -1,1 +1,31 @@
-"""apex_tpu.models — see package docstring in apex_tpu/__init__.py."""
+"""apex_tpu.models — flagship model zoo (TP/SP-parallel flax).
+
+Mirrors the reference's ``apex/transformer/testing/{standalone_gpt,
+standalone_bert}.py`` toy models and the BASELINE.json workload configs
+(BERT-Large north star, GPT-2 1.3B TP), built on the parallel
+transformer core.
+"""
+
+from apex_tpu.models.transformer import (
+    TransformerConfig,
+    ParallelTransformer,
+    ParallelTransformerLayer,
+    ParallelAttention,
+    ParallelMLP,
+)
+from apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
+from apex_tpu.models.bert import BertConfig, BertModel, bert_mlm_loss_fn
+
+__all__ = [
+    "TransformerConfig",
+    "ParallelTransformer",
+    "ParallelTransformerLayer",
+    "ParallelAttention",
+    "ParallelMLP",
+    "GPTConfig",
+    "GPTModel",
+    "gpt_loss_fn",
+    "BertConfig",
+    "BertModel",
+    "bert_mlm_loss_fn",
+]

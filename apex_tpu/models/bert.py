@@ -1,0 +1,130 @@
+"""BERT — encoder flagship model (the north-star workload).
+
+Reference: ``apex/transformer/testing/standalone_bert.py`` plus the
+BASELINE.json north star: *BERT-Large pretraining, amp O2 + FusedAdam +
+FusedLayerNorm, samples/sec/chip*.  Architecture follows the classic
+BERT recipe (learned positions + token types, post-embedding LN,
+bidirectional encoder, MLM head with tied decoder + binary NSP head).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.core.mesh import TENSOR_AXIS
+from apex_tpu.models.transformer import (
+    ParallelTransformer,
+    TransformerConfig,
+    _norm,
+)
+from apex_tpu.ops.attention import mask_to_bias
+from apex_tpu.ops.layer_norm import fused_layer_norm
+from apex_tpu.ops.xentropy import mean_cross_entropy
+from apex_tpu.transformer.layers import (
+    VocabParallelEmbedding,
+    maybe_constrain,
+)
+
+__all__ = ["BertConfig", "BertModel", "bert_mlm_loss_fn"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig(TransformerConfig):
+    """BERT presets; bidirectional, learned positions."""
+
+    causal: bool = False
+    position_embedding: str = "learned"
+    type_vocab_size: int = 2
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        kw.setdefault("vocab_size", 1024)
+        kw.setdefault("hidden_size", 256)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 2)
+        kw.setdefault("max_seq_len", 128)
+        return cls(**kw)
+
+    @classmethod
+    def bert_large(cls, **kw) -> "BertConfig":
+        """The north-star config (BASELINE.json): BERT-Large."""
+        kw.setdefault("vocab_size", 30528)
+        kw.setdefault("hidden_size", 1024)
+        kw.setdefault("num_layers", 24)
+        kw.setdefault("num_heads", 16)
+        kw.setdefault("max_seq_len", 512)
+        return cls(**kw)
+
+
+class BertModel(nn.Module):
+    """Encoder; returns ``(mlm_logits, pooled)``.
+
+    ``mlm_logits``: (b, s, vocab) tied-decoder MLM predictions;
+    ``pooled``: (b, hidden) tanh-pooled [CLS] for NSP/classification.
+    """
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, token_type_ids=None,
+                 attention_mask=None, deterministic: bool = True):
+        cfg = self.cfg
+        emb = VocabParallelEmbedding(
+            num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="embedding")
+        x = emb(input_ids)
+        pos_table = self.param(
+            "position_embedding", nn.initializers.normal(0.02),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
+        x = x + pos_table[None, : x.shape[1]].astype(x.dtype)
+        if cfg.type_vocab_size:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            type_table = self.param(
+                "token_type_embedding", nn.initializers.normal(0.02),
+                (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype)
+            x = x + jnp.take(type_table, token_type_ids,
+                             axis=0).astype(x.dtype)
+        ln_w = self.param("emb_norm_scale", nn.initializers.ones_init(),
+                          (cfg.hidden_size,), cfg.param_dtype)
+        ln_b = self.param("emb_norm_bias", nn.initializers.zeros_init(),
+                          (cfg.hidden_size,), cfg.param_dtype)
+        x = fused_layer_norm(x, ln_w, ln_b, eps=cfg.layernorm_eps)
+        x = x.astype(cfg.dtype)
+
+        mask_bias = None
+        if attention_mask is not None:
+            # (b, s) with 1 = attend, 0 = padding (HF/apex convention);
+            # the (b, 1, 1, s) key-padding shape rides the flash kernel
+            mask_bias = mask_to_bias(
+                ~attention_mask[:, None, None, :].astype(bool))
+        x = ParallelTransformer(cfg, name="transformer")(
+            x, mask_bias=mask_bias, deterministic=deterministic)
+
+        # MLM head: dense + gelu + LN + tied decoder (BERT recipe)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="mlm_dense")(x)
+        h = jax.nn.gelu(h, approximate=True)
+        h = _norm(cfg, "mlm_norm")(h).astype(cfg.dtype)
+        mlm_logits = emb.attend(h)
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros_init(),
+                              (cfg.vocab_size,), cfg.param_dtype)
+        mlm_logits = mlm_logits + mlm_bias.astype(mlm_logits.dtype)
+        mlm_logits = maybe_constrain(mlm_logits, "data", None, TENSOR_AXIS)
+
+        pooled = nn.tanh(nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="pooler")(x[:, 0]))
+        return mlm_logits, pooled
+
+
+def bert_mlm_loss_fn(mlm_logits, labels, *, ignore_index: int = -100):
+    """Masked-LM CE averaged over masked positions (fp32)."""
+    return mean_cross_entropy(mlm_logits, labels,
+                              ignore_index=ignore_index)

@@ -1,0 +1,265 @@
+"""Parallel transformer core — the flagship model building block.
+
+Reference: ``apex/transformer/testing/{standalone_gpt,standalone_bert}.py``
+(toy Megatron models the reference's test suite trains) and the layer
+recipe of SURVEY.md §3.4: pre-LN → ColumnParallel qkv → RoPE → fused
+attention → RowParallel out → residual → pre-LN → ColumnParallel h→ffn
+(+GeLU) → RowParallel ffn→h → residual, with ``sequence_parallel``
+sharding the LN/residual activations along the sequence.
+
+TPU-first shape: one flax module family under GSPMD — weights carry
+``nn.with_partitioning`` specs over the ``tensor`` mesh axis, activations
+get ``with_sharding_constraint`` hints, and XLA inserts the same
+all-gather/reduce-scatter pairs the reference hand-codes.  Layers are
+stacked with ``nn.scan`` (one trace/compile for N layers) and optionally
+``nn.remat`` (activation checkpointing ≙
+``tensor_parallel.random.checkpoint``, SURVEY.md §2.6 — RNG replay is
+free because everything is functional).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.core.mesh import TENSOR_AXIS
+from apex_tpu.ops.attention import fused_attention
+from apex_tpu.ops.layer_norm import fused_layer_norm, fused_rms_norm
+from apex_tpu.ops.rope import fused_rope, rope_cos_sin
+from apex_tpu.transformer.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    maybe_constrain,
+)
+
+__all__ = ["TransformerConfig", "ParallelTransformerLayer",
+           "ParallelTransformer", "ParallelMLP", "ParallelAttention"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture + parallelism knobs shared by the model zoo."""
+
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    num_kv_heads: Optional[int] = None      # GQA; None = num_heads
+    ffn_hidden_size: Optional[int] = None   # None = 4*hidden
+    max_seq_len: int = 2048
+    # positional scheme: "rope" (GPT-NeoX/Llama) or "learned" (BERT/GPT-2)
+    position_embedding: str = "rope"
+    rotary_pct: float = 1.0
+    rope_base: float = 10000.0
+    norm: str = "layernorm"                 # or "rmsnorm"
+    layernorm_eps: float = 1e-5
+    causal: bool = True
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    activation: str = "gelu"
+    # parallel / compile behavior
+    sequence_parallel: bool = False
+    remat: bool = False
+    scan_layers: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    def __post_init__(self):
+        if self.hidden_size % self.num_heads:
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must divide hidden_size "
+                f"({self.hidden_size})")
+        if self.num_kv_heads and self.num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"num_kv_heads ({self.num_kv_heads}) must divide "
+                f"num_heads ({self.num_heads})")
+        if self.position_embedding not in ("rope", "learned", "none"):
+            raise ValueError(
+                f"position_embedding={self.position_embedding!r} not in "
+                "('rope', 'learned', 'none')")
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(
+                f"norm={self.norm!r} not in ('layernorm', 'rmsnorm')")
+
+
+def _norm(cfg: TransformerConfig, name: str):
+    """Fused pre-norm as a parameterized closure over a flax scope."""
+    class _Norm(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            w = self.param("scale", nn.initializers.ones_init(),
+                           (cfg.hidden_size,), cfg.param_dtype)
+            if cfg.norm == "rmsnorm":
+                return fused_rms_norm(x, w, eps=cfg.layernorm_eps)
+            b = self.param("bias", nn.initializers.zeros_init(),
+                           (cfg.hidden_size,), cfg.param_dtype)
+            return fused_layer_norm(x, w, b, eps=cfg.layernorm_eps)
+    return _Norm(name=name)
+
+
+class ParallelAttention(nn.Module):
+    """TP attention block: ColumnParallel qkv → RoPE → flash → RowParallel.
+
+    Head-sharded over the ``tensor`` axis (qkv ColumnParallel shards the
+    head dim product; out-proj RowParallel reduces), the reference's
+    layer recipe (SURVEY.md §3.4 steps 1-5).
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, mask_bias=None, deterministic: bool = True):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        h, hk, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        qkv_features = (h + 2 * hk) * d
+        qkv = ColumnParallelLinear(
+            features=qkv_features, use_bias=True,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="qkv_proj")(x)
+        q = qkv[..., : h * d].reshape(b, s, h, d)
+        k = qkv[..., h * d: (h + hk) * d].reshape(b, s, hk, d)
+        v = qkv[..., (h + hk) * d:].reshape(b, s, hk, d)
+        if cfg.position_embedding == "rope":
+            rot = int(cfg.rotary_pct * d) // 2 * 2
+            cos, sin = rope_cos_sin(s, rot, base=cfg.rope_base)
+            q = fused_rope(q, cos, sin)
+            k = fused_rope(k, cos, sin)
+        o = fused_attention(q, k, v, causal=cfg.causal, bias=mask_bias)
+        if cfg.attention_dropout > 0.0 and not deterministic:
+            o = nn.Dropout(rate=cfg.attention_dropout)(
+                o, deterministic=False)
+        o = o.reshape(b, s, h * d)
+        return RowParallelLinear(
+            features=cfg.hidden_size, use_bias=True,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="out_proj")(o)
+
+
+class ParallelMLP(nn.Module):
+    """TP MLP: ColumnParallel h→ffn (+act) → RowParallel ffn→h.
+
+    The reference's ``apex.mlp.MLP``/``FusedDenseGeluDense`` fused into
+    the TP recipe — XLA fuses bias+GeLU into the matmul epilogue.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        y = ColumnParallelLinear(
+            features=cfg.ffn_size, use_bias=True,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="dense_h_to_4h")(x)
+        if cfg.activation == "gelu":
+            y = jax.nn.gelu(y, approximate=True)
+        elif cfg.activation == "relu":
+            y = jax.nn.relu(y)
+        elif cfg.activation == "silu":
+            y = jax.nn.silu(y)
+        else:
+            raise ValueError(f"unknown activation {cfg.activation!r}")
+        return RowParallelLinear(
+            features=cfg.hidden_size, use_bias=True,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="dense_4h_to_h")(y)
+
+
+class ParallelTransformerLayer(nn.Module):
+    """Pre-LN transformer block (Megatron layer recipe)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, mask_bias=None, deterministic: bool = True):
+        cfg = self.cfg
+        seq_spec = (TENSOR_AXIS if cfg.sequence_parallel else None)
+        x = maybe_constrain(x, "data", seq_spec)
+        a = _norm(cfg, "input_norm")(x)
+        a = ParallelAttention(cfg, name="attention")(
+            a, mask_bias=mask_bias, deterministic=deterministic)
+        if cfg.hidden_dropout > 0.0 and not deterministic:
+            a = nn.Dropout(rate=cfg.hidden_dropout)(a, deterministic=False)
+        x = x + a.astype(x.dtype)
+        m = _norm(cfg, "post_attention_norm")(x)
+        m = ParallelMLP(cfg, name="mlp")(m)
+        if cfg.hidden_dropout > 0.0 and not deterministic:
+            m = nn.Dropout(rate=cfg.hidden_dropout)(m, deterministic=False)
+        x = x + m.astype(x.dtype)
+        return maybe_constrain(x, "data", seq_spec)
+
+
+class _ScanBlock(nn.Module):
+    """One layer in scan-carry form: ``x -> (x', None)``."""
+
+    cfg: TransformerConfig
+    deterministic: bool
+
+    @nn.compact
+    def __call__(self, x, mask_bias):
+        y = ParallelTransformerLayer(self.cfg, name="layer")(
+            x, mask_bias=mask_bias, deterministic=self.deterministic)
+        return y, None
+
+
+class ParallelTransformer(nn.Module):
+    """N stacked layers via ``nn.scan`` (+ optional ``nn.remat``).
+
+    ``scan_layers=True`` compiles ONE layer and iterates it — compile
+    time stays flat in depth; parameters get a leading layer axis
+    (sharded spec-compatible).  ``remat=True`` recomputes each layer's
+    activations in backward (``jax.checkpoint``), the functional
+    equivalent of the reference's ``tensor_parallel.random.checkpoint``.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, mask_bias=None, deterministic: bool = True):
+        cfg = self.cfg
+        if cfg.scan_layers:
+            block_cls = _ScanBlock
+            if cfg.remat:
+                block_cls = nn.remat(
+                    block_cls, prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            stack = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=nn.broadcast,
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: None},
+            )
+            x, _ = stack(cfg, deterministic, name="layers")(x, mask_bias)
+        else:
+            layer_cls = ParallelTransformerLayer
+            if cfg.remat:
+                layer_cls = nn.remat(
+                    layer_cls, prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, name=f"layer_{i}")(
+                    x, mask_bias=mask_bias, deterministic=deterministic)
+        return x

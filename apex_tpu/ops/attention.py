@@ -36,9 +36,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._dispatch import resolve_impl
 
-__all__ = ["fused_attention", "attention_reference"]
+__all__ = ["fused_attention", "attention_reference", "mask_to_bias"]
 
 _NEG_INF = -1e30
+
+
+def mask_to_bias(masked):
+    """Boolean mask (True = masked) → additive -inf bias, fp32.
+
+    The single source of the masking sentinel: biases built with this
+    helper hit the kernels' dead-position zeroing (positions below
+    ``0.5 * _NEG_INF`` contribute exactly zero probability).
+    """
+    return jnp.where(masked, _NEG_INF, 0.0).astype(jnp.float32)
 
 
 # --------------------------------------------------------------------- #
@@ -67,11 +77,13 @@ def attention_reference(q, k, v, *, causal: bool = False,
         sk = k.shape[1]
         q_idx = jnp.arange(sq)[:, None]
         k_idx = jnp.arange(sk)[None, :]
-        masked = k_idx > q_idx + (sk - sq)
-        p = jax.nn.softmax(jnp.where(masked, _NEG_INF, s), axis=-1)
-        p = jnp.where(masked, 0.0, p)              # zero fully-masked rows
-    else:
-        p = jax.nn.softmax(s, axis=-1)
+        s = jnp.where(k_idx > q_idx + (sk - sq), _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    if causal or bias is not None:
+        # dead positions (score pushed below the -inf sentinel) get
+        # exactly zero probability; fully-dead rows output zeros — the
+        # flash-attention convention, matched by the Pallas kernel
+        p = jnp.where(s < 0.5 * _NEG_INF, 0.0, p)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return o.astype(q.dtype)
 
@@ -79,9 +91,41 @@ def attention_reference(q, k, v, *, causal: bool = False,
 # --------------------------------------------------------------------- #
 # forward kernel
 # --------------------------------------------------------------------- #
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                   acc_ref, m_ref, l_ref, *,
-                   scale, causal, bq, bk, sk_blocks, sq, sk):
+def _scores(q_ref, k_ref, kvb_ref, i, j, *, scale, causal, bq, bk,
+            sq, sk):
+    """Scaled scores for one (q-block, kv-block) tile: qkᵀ·scale
+    (+ kv bias) with causal positions pushed to -inf."""
+    q = q_ref[0].astype(jnp.float32)               # (bq, d)
+    k = k_ref[0].astype(jnp.float32)               # (bk, d)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (bq, bk)
+    if kvb_ref is not None:
+        s = s + kvb_ref[0, 0][None, :]             # (1, 1, bk) kv bias
+    if causal:
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(k_pos > q_pos + (sk - sq), _NEG_INF, s)
+    return s
+
+
+def _zero_dead(s, p, causal, has_bias):
+    """Zero probabilities at dead positions (score below the -inf
+    sentinel).  Needed because a fully-dead row has max/lse == -inf and
+    exp(s - m) == 1 there; dead rows must output exactly zero."""
+    if causal or has_bias:
+        return jnp.where(s < 0.5 * _NEG_INF, 0.0, p)
+    return p
+
+
+def _fa_fwd_kernel(*refs, scale, causal, has_bias, bq, bk, sk_blocks,
+                   sq, sk):
+    if has_bias:
+        (q_ref, k_ref, v_ref, kvb_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        kvb_ref = None
     j = pl.program_id(2)
     i = pl.program_id(1)
 
@@ -98,27 +142,12 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(block_live)
     def _step():
-        q = q_ref[0].astype(jnp.float32)          # (bq, d)
-        k = k_ref[0].astype(jnp.float32)          # (bk, d)
         v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # (bq, bk)
-        masked = None
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            masked = k_pos > q_pos + (sk - sq)
-            s = jnp.where(masked, _NEG_INF, s)
+        s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
+                    causal=causal, bq=bq, bk=bk, sq=sq, sk=sk)
         m_prev = m_ref[:]                          # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)                     # (bq, bk)
-        if masked is not None:
-            # fully-masked rows have m_new == _NEG_INF, making
-            # exp(s - m_new) == 1; zero them so such rows output 0
-            p = jnp.where(masked, 0.0, p)
+        p = _zero_dead(s, jnp.exp(s - m_new), causal, has_bias)
         alpha = jnp.exp(m_prev - m_new)            # (bq, 1)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -131,39 +160,59 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_ref[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+        lse_ref[0, 0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
 
 
-def _run_fa_fwd(q3, k3, v3, scale, causal, rep, bq, bk, interpret):
+def _qkv_specs(d, bq, bk, rep):
+    """BlockSpecs for q/k/v under grid (b*h, i, j).  GQA: `rep`
+    consecutive q heads share one kv head — the kv BlockSpecs index
+    b // rep, so kv is never materialized per-q-head in HBM."""
+    return [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+
+
+def _kvb_spec(bk, nh):
+    """(batch, 1, sk) kv-bias block under grid (b*h, i, j):
+    batch = b // nh.  The middle singleton keeps the block's last two
+    dims TPU-tileable ((1, bk): 1 == array dim, bk % 128 == 0)."""
+    return pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // nh, 0, j),
+                        memory_space=pltpu.VMEM)
+
+
+def _run_fa_fwd(q3, k3, v3, kvb, scale, causal, rep, nh, bq, bk,
+                interpret):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     grid = (bh, sq // bq, sk // bk)
+    has_bias = kvb is not None
     kernel = functools.partial(
-        _fa_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-        sk_blocks=sk // bk, sq=sq, sk=sk)
+        _fa_fwd_kernel, scale=scale, causal=causal, has_bias=has_bias,
+        bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq, sk=sk)
+    in_specs = _qkv_specs(d, bq, bk, rep)
+    args = [q3, k3, v3]
+    if has_bias:
+        in_specs.append(_kvb_spec(bk, nh))
+        args.append(kvb)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            # GQA: `rep` consecutive q heads share one kv head — the kv
-            # BlockSpecs index b // rep, so kv is never materialized
-            # per-q-head in HBM (no jnp.repeat)
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            # (bh, 1, sq): middle singleton keeps blocks TPU-tileable
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -171,16 +220,21 @@ def _run_fa_fwd(q3, k3, v3, scale, causal, rep, bq, bk, interpret):
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*args)
     return o, lse
 
 
 # --------------------------------------------------------------------- #
 # backward kernels
 # --------------------------------------------------------------------- #
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, acc_ref, *,
-                      scale, causal, bq, bk, sk_blocks, sq, sk):
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
+                      *refs, scale, causal, has_bias, bq, bk,
+                      sk_blocks, sq, sk):
+    if has_bias:
+        kvb_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs
+    else:
+        do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs
+        kvb_ref = None
     j = pl.program_id(2)
     i = pl.program_id(1)
 
@@ -193,24 +247,16 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(block_live)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]                  # (bq, 1)
-        delta = delta_ref[0][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lse)                       # (bq, bk)
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            # zero rather than -inf: fully-masked rows (lse == -inf)
-            # would otherwise get exp(-inf - -inf) == 1
-            p = jnp.where(k_pos > q_pos + (sk - sq), 0.0, p)
+        lse = lse_ref[0, 0][:, None]               # (bq, 1)
+        delta = delta_ref[0, 0][:, None]
+        s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
+                    causal=causal, bq=bq, bk=bk, sq=sq, sk=sk)
+        # dead rows have lse == -inf making exp(s - lse) == 1 there;
+        # _zero_dead restores exact zeros
+        p = _zero_dead(s, jnp.exp(s - lse), causal, has_bias)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bq, bk)
@@ -224,9 +270,15 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, dk_acc, dv_acc, *,
-                       scale, causal, bq, bk, sq_blocks, sq, sk):
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
+                       *refs, scale, causal, has_bias, bq, bk,
+                       sq_blocks, sq, sk):
+    if has_bias:
+        kvb_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, \
+            dk_acc, dv_acc = refs
+    else:
+        do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+        kvb_ref = None
     i = pl.program_id(2)      # q block (sequential axis)
     j = pl.program_id(1)      # kv block
 
@@ -241,23 +293,13 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(block_live)
     def _step():
         q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lse)                       # (bq, bk)
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            # zero rather than -inf: fully-masked rows (lse == -inf)
-            # would otherwise get exp(-inf - -inf) == 1
-            p = jnp.where(k_pos > q_pos + (sk - sq), 0.0, p)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
+                    causal=causal, bq=bq, bk=bk, sq=sq, sk=sk)
+        p = _zero_dead(s, jnp.exp(s - lse), causal, has_bias)
         # dv += pᵀ @ do
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -277,64 +319,76 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _run_fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, rep, bq, bk,
-                interpret):
+def _run_fa_bwd(q3, k3, v3, kvb, o3, lse, do3, scale, causal, rep, nh,
+                bq, bk, interpret):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
+    has_bias = kvb is not None
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
-                    axis=-1)                       # (bh, sq)
+                    axis=-1)[:, None, :]           # (bh, 1, sq)
 
     dq_kernel = functools.partial(
-        _fa_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-        sk_blocks=sk // bk, sq=sq, sk=sk)
+        _fa_bwd_dq_kernel, scale=scale, causal=causal, has_bias=has_bias,
+        bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq, sk=sk)
+    in_specs = _qkv_specs(d, bq, bk, rep)
+    args = [q3, k3, v3]
+    if has_bias:
+        in_specs.append(_kvb_spec(bk, nh))
+        args.append(kvb)
+    in_specs += [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+    ]
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, sq // bq, sk // bk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(*args, do3, lse, delta)
 
     dkv_kernel = functools.partial(
-        _fa_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-        sq_blocks=sq // bq, sq=sq, sk=sk)
+        _fa_bwd_dkv_kernel, scale=scale, causal=causal,
+        has_bias=has_bias, bq=bq, bk=bk, sq_blocks=sq // bq, sq=sq,
+        sk=sk)
     # dk/dv are computed per *q* head (grid axis 0 = b*h) so each output
     # block is owned by one grid lane; for GQA the rep-sized head groups
     # are summed afterwards (cheap, fp32) instead of making the kernel
-    # revisit shared kv output blocks.
+    # revisit shared kv output blocks.  NB grid order (b, j, i): the
+    # index maps below permute accordingly.
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b // rep, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b // rep, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q3, k3, v3]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda b, j, i: (b // nh, 0, j),
+                         memory_space=pltpu.VMEM))
+        args.append(kvb)
+    in_specs += [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+    ]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, sk // bk, sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b // rep, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b // rep, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
                          memory_space=pltpu.VMEM),
@@ -354,7 +408,7 @@ def _run_fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, rep, bq, bk,
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(*args, do3, lse, delta)
     if rep > 1:
         dk = dk.reshape(bh // rep, rep, sk, d).sum(axis=1)
         dv = dv.reshape(bh // rep, rep, sk, d).sum(axis=1)
@@ -364,23 +418,27 @@ def _run_fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, rep, bq, bk,
 # --------------------------------------------------------------------- #
 # custom VJP over (b*h, s, d) arrays
 # --------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _fa_pallas(q3, k3, v3, scale, causal, rep, bq, bk, interpret):
-    o, _ = _run_fa_fwd(q3, k3, v3, scale, causal, rep, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _fa_pallas(q3, k3, v3, kvb, scale, causal, rep, nh, bq, bk,
+               interpret):
+    o, _ = _run_fa_fwd(q3, k3, v3, kvb, scale, causal, rep, nh, bq, bk,
+                       interpret)
     return o
 
 
-def _fa_pallas_fwd(q3, k3, v3, scale, causal, rep, bq, bk, interpret):
-    o, lse = _run_fa_fwd(q3, k3, v3, scale, causal, rep, bq, bk,
-                         interpret)
-    return o, (q3, k3, v3, o, lse)
+def _fa_pallas_fwd(q3, k3, v3, kvb, scale, causal, rep, nh, bq, bk,
+                   interpret):
+    o, lse = _run_fa_fwd(q3, k3, v3, kvb, scale, causal, rep, nh, bq,
+                         bk, interpret)
+    return o, (q3, k3, v3, kvb, o, lse)
 
 
-def _fa_pallas_bwd(scale, causal, rep, bq, bk, interpret, res, do):
-    q3, k3, v3, o, lse = res
-    dq, dk, dv = _run_fa_bwd(q3, k3, v3, o, lse, do, scale, causal,
-                             rep, bq, bk, interpret)
-    return dq, dk, dv
+def _fa_pallas_bwd(scale, causal, rep, nh, bq, bk, interpret, res, do):
+    q3, k3, v3, kvb, o, lse = res
+    dq, dk, dv = _run_fa_bwd(q3, k3, v3, kvb, o, lse, do, scale, causal,
+                             rep, nh, bq, bk, interpret)
+    # kv bias comes from a padding mask — not differentiated
+    return dq, dk, dv, None
 
 
 _fa_pallas.defvjp(_fa_pallas_fwd, _fa_pallas_bwd)
@@ -389,17 +447,35 @@ _fa_pallas.defvjp(_fa_pallas_fwd, _fa_pallas_bwd)
 # --------------------------------------------------------------------- #
 # public API
 # --------------------------------------------------------------------- #
+def _pick_block(s: int, want: int) -> int:
+    """Largest block ≤ ``want`` that divides ``s`` (multiple-of-128
+    lane alignment preferred), so e.g. s=768 gets 384 blocks instead of
+    falling off the Pallas path; short/odd sequences run as one block."""
+    if s <= want:
+        return s
+    best = 0
+    for cand in range(128, want + 1, 128):
+        if s % cand == 0:
+            best = cand
+    if best:
+        return best
+    # s not a multiple of 128: single-block only if small enough for
+    # VMEM; otherwise return `want` (won't divide s -> XLA fallback)
+    return s if s <= 2 * want else want
+
 def fused_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     bias=None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     implementation: Optional[str] = None):
     """Flash multi-head attention (BSHD layout), O(S) memory.
 
     Drop-in for the reference's ``SelfMultiheadAttn`` core /
-    ``fmha`` (SURVEY.md §2.7).  ``bias`` (additive, e.g. relative
-    position) currently routes to the XLA path.  GQA/MQA supported via
-    fewer kv heads.
+    ``fmha`` (SURVEY.md §2.7).  A ``bias`` broadcastable as
+    ``(b, 1, 1, sk)`` — e.g. a key-padding mask from
+    :func:`mask_to_bias` — rides the Pallas kernel; richer biases
+    (per-query/per-head) route to the XLA composition.  GQA/MQA
+    supported via fewer kv heads.
     """
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
@@ -407,11 +483,21 @@ def fused_attention(q, k, v, *, causal: bool = False,
         raise ValueError(
             f"num_kv_heads ({hk}) must divide num_heads ({h})")
     scale = (d ** -0.5) if scale is None else float(scale)
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    # a bias broadcastable as (b, 1, 1, sk) — e.g. a key-padding mask —
+    # rides the Pallas kernel as a per-key additive row; anything richer
+    # (per-query/per-head bias) falls back to the XLA composition
+    kvb = None
+    if bias is not None and bias.ndim == 4 and bias.shape[1:3] == (1, 1) \
+            and bias.shape[3] == sk and bias.shape[0] in (1, b):
+        kvb = jnp.broadcast_to(
+            bias[:, 0, 0, :], (b, sk)).astype(jnp.float32)[:, None, :]
     pallas_ok = (
-        bias is None
-        and d % 128 == 0
+        (bias is None or kvb is not None)
+        # blocks span the whole head dim, so any multiple of the fp32
+        # sublane works (d=64 covers BERT-Large; 128 fills MXU lanes)
+        and d % 8 == 0
         and sq % bq == 0 and sk % bk == 0
         and q.dtype == k.dtype == v.dtype
     )
@@ -425,6 +511,6 @@ def fused_attention(q, k, v, *, causal: bool = False,
     q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     k3 = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
     v3 = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
-    o3 = _fa_pallas(q3, k3, v3, scale, bool(causal), h // hk, bq, bk,
-                    interpret)
+    o3 = _fa_pallas(q3, k3, v3, kvb, scale, bool(causal), h // hk, h,
+                    bq, bk, interpret)
     return o3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from apex_tpu.ops.attention import _NEG_INF, fused_attention
+from apex_tpu.ops.attention import fused_attention, mask_to_bias
 from apex_tpu.ops.layer_norm import fused_layer_norm
 
 __all__ = ["SelfMultiheadAttn", "EncdecMultiheadAttn"]
@@ -40,7 +40,7 @@ def _attention_bias(mask, key_padding_mask):
     def to_bias(m):
         m = jnp.asarray(m)
         if m.dtype == jnp.bool_:
-            return jnp.where(m, _NEG_INF, 0.0).astype(jnp.float32)
+            return mask_to_bias(m)
         return m.astype(jnp.float32)
 
     bias = None

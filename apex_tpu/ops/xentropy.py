@@ -27,7 +27,20 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["softmax_cross_entropy", "softmax_cross_entropy_reference"]
+__all__ = ["softmax_cross_entropy", "softmax_cross_entropy_reference",
+           "mean_cross_entropy"]
+
+
+def mean_cross_entropy(logits, labels, *, smoothing: float = 0.0,
+                       ignore_index: int = -100):
+    """CE averaged over *valid* (non-ignored) tokens, fp32.
+
+    The shared LM/MLM reduction: padding fraction must not dilute the
+    loss or the gradient scale."""
+    per_tok = softmax_cross_entropy(logits, labels, smoothing,
+                                    ignore_index)
+    n = jnp.maximum(jnp.sum(labels != ignore_index), 1)
+    return jnp.sum(per_tok) / n
 
 
 def softmax_cross_entropy_reference(logits, labels, *,

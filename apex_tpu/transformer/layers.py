@@ -179,21 +179,23 @@ class VocabParallelEmbedding(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     embedding_init: Callable = nn.initializers.normal(stddev=0.02)
 
-    @nn.compact
-    def __call__(self, ids):
-        table = self.param(
+    def setup(self):
+        self.embedding = self.param(
             "embedding",
             nn.with_partitioning(self.embedding_init, (self.axis, None)),
             (self.num_embeddings, self.features), self.param_dtype)
+
+    def __call__(self, ids):
         dtype = self.dtype or self.param_dtype
-        y = jnp.take(table.astype(dtype), ids, axis=0)
+        y = jnp.take(jnp.asarray(self.embedding).astype(dtype), ids,
+                     axis=0)
         return maybe_constrain(y, "data")
 
-    def attend(self, variables, x):
-        """Logits against the (sharded) table — output-embedding tying."""
-        table = variables["params"]["embedding"]
-        if hasattr(table, "unbox"):
-            table = table.unbox()
+    def attend(self, x):
+        """Logits against the (sharded) table — output-embedding tying
+        (vocab-sharded logits out, like the reference's parallel LM head).
+        """
+        table = jnp.asarray(self.embedding)
         y = jax.lax.dot_general(
             x, table.astype(x.dtype),
             (((x.ndim - 1,), (1,)), ((), ())),

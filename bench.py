@@ -1,0 +1,122 @@
+"""Benchmark harness — north-star metric on real TPU hardware.
+
+Emits ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric (BASELINE.json north star): BERT-Large pretraining train-step
+throughput, samples/sec/chip, with the full apex-O2-equivalent stack —
+precision policy O2 (bf16 compute, fp32 masters), FusedAdam, fused
+(Pallas) layer norm + flash attention.  ``vs_baseline`` is the measured
+speedup over the same model run at O0 (pure fp32, plain optax adam,
+XLA-composition ops) — the reference's advertised amp+fusion gain,
+measured rather than quoted (BASELINE.md: no number published in-repo).
+
+Env knobs: BENCH_BATCH, BENCH_SEQ, BENCH_STEPS, BENCH_TINY=1 (smoke).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def _build(cfg_kw, opt_level, half_dtype, fused):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from apex_tpu import amp
+    from apex_tpu.models import BertConfig, BertModel, bert_mlm_loss_fn
+    from apex_tpu.optim import fused_adam
+
+    cfg = BertConfig.bert_large(**cfg_kw) if not int(
+        os.environ.get("BENCH_TINY", "0")) else BertConfig.tiny(**cfg_kw)
+    model = BertModel(cfg)
+    tx = fused_adam(1e-4) if fused else optax.adam(1e-4)
+
+    b = int(os.environ.get("BENCH_BATCH", "16"))
+    s = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_seq_len, 512))))
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (b, s), 0, cfg.vocab_size)
+    labels = jax.numpy.where(
+        jax.random.uniform(rng, (b, s)) < 0.15, ids, -100)
+
+    params = model.init(jax.random.PRNGKey(0), ids[:2])
+    state = amp.initialize(model.apply, params, tx, opt_level=opt_level,
+                           half_dtype=half_dtype)
+
+    @jax.jit
+    def step(state, ids, labels):
+        def loss_fn(p):
+            cp = state.policy.cast_to_compute(p)
+            logits, _ = state.apply_fn(
+                cp, ids, deterministic=True)
+            loss = bert_mlm_loss_fn(
+                logits.astype(jnp.float32), labels)
+            return state.scale_loss(loss), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
+        new_state, finite = state.apply_gradients(grads=grads)
+        return new_state, loss, finite
+
+    return state, step, (ids, labels), b
+
+
+def _sync(state):
+    """Force full execution.  On the axon (tunneled-TPU) backend
+    ``block_until_ready`` returns before execution finishes — only a
+    host transfer truly syncs, so fetch one scalar off the final state
+    (it depends transitively on every step)."""
+    import jax
+
+    leaf = jax.tree.leaves(state.params)[0]
+    jax.device_get(leaf.ravel()[0])
+
+
+def _measure(state, step, batch, n_steps, warmup=3):
+    ids, labels = batch
+    for _ in range(warmup):
+        state, loss, finite = step(state, ids, labels)
+    _sync(state)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, loss, finite = step(state, ids, labels)
+    _sync(state)
+    dt = (time.perf_counter() - t0) / n_steps
+    return dt, float(loss), bool(finite)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    cfg_kw = {"remat": True, "dtype": jnp.float32}
+    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    # O2 + FusedAdam + fused kernels (the north-star stack)
+    state, step, batch, b = _build(
+        dict(cfg_kw, dtype=jnp.bfloat16), "O2", jnp.bfloat16, fused=True)
+    dt_o2, loss, finite = _measure(state, step, batch, n_steps)
+    del state, step
+
+    # O0 fp32 + plain optax adam (the "eager" baseline).  Force true
+    # fp32 matmuls: TPU's default precision would silently run bf16
+    # passes, understating the O2 gain.
+    with jax.default_matmul_precision("highest"):
+        state, step, batch, _ = _build(cfg_kw, "O0", None, fused=False)
+        dt_o0, _, _ = _measure(state, step, batch, max(n_steps // 2, 5))
+    del state, step
+
+    # the benchmark is unsharded: everything executes on one chip
+    samples_sec_chip = b / dt_o2
+    print(json.dumps({
+        "metric": "bert_large_pretrain_O2_fusedadam_samples_per_sec_per_chip",
+        "value": round(samples_sec_chip, 3),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(dt_o0 / dt_o2, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
