@@ -54,7 +54,22 @@ def maybe_constrain(x, *spec):
     """``with_sharding_constraint`` if a mesh is initialized, else noop.
 
     Lets the same module run on a laptop (no mesh) and a pod slice.
+    Axes not present in the ambient mesh — or manual (shard_map'ed,
+    e.g. ``pipe`` inside the pipeline schedule) — are dropped from the
+    spec, so TP/SP constraints compose with any surrounding topology.
     """
+    abstract = jax.sharding.get_abstract_mesh()
+    if not abstract.empty:
+        # inside jax.set_mesh / shard_map: resolve against the ambient
+        # abstract mesh, keeping only its Auto (GSPMD-managed) axes
+        auto = {n for n, t in zip(abstract.axis_names,
+                                  abstract.axis_types)
+                if t == jax.sharding.AxisType.Auto}
+        spec = tuple(s if s in auto else None for s in spec)
+        if all(s is None for s in spec):
+            return x
+        return lax.with_sharding_constraint(
+            x, jax.sharding.PartitionSpec(*spec))
     try:
         mesh = mesh_lib.get_mesh()
     except RuntimeError:

@@ -1,0 +1,22 @@
+"""apex_tpu.transformer.pipeline_parallel — microbatch pipeline engine.
+
+Reference: ``apex/transformer/pipeline_parallel/`` (schedules +
+p2p_communication + utils).  See :mod:`.schedules` for the TPU design
+(scan + ppermute inside shard_map; backward by transposition).
+"""
+
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    spmd_pipeline,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+)
+from apex_tpu.transformer.pipeline_parallel import p2p
+
+__all__ = [
+    "spmd_pipeline",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "get_forward_backward_func",
+    "p2p",
+]

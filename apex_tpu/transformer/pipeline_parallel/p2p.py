@@ -1,0 +1,67 @@
+"""Stage-to-stage communication over the ``pipe`` mesh axis.
+
+Reference: ``apex/transformer/pipeline_parallel/p2p_communication.py`` —
+batched NCCL isend/irecv (``torch.distributed.P2POp``) with a
+shape/dtype handshake and fused ``send_forward_recv_backward`` ops.
+
+TPU translation: a pipeline "send to next stage" is one
+``lax.ppermute`` over the ``pipe`` axis — a neighbor exchange on ICI.
+Shapes are static under jit, so the reference's handshake disappears;
+"batched p2p" disappears because a single ppermute moves any pytree.
+These helpers are usable only inside ``shard_map`` with the ``pipe``
+axis bound; the scheduler (:mod:`.schedules`) composes them.
+
+Semantics note: ppermute is a *collective* permutation — "send forward"
+necessarily also "receives" from the previous stage (the first stage
+receives the last stage's tensor, which schedules mask out), which is
+exactly how the reference fuses ``send_forward_recv_forward``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax import lax
+
+from apex_tpu.core.mesh import PIPE_AXIS
+
+__all__ = [
+    "send_forward_recv_forward",
+    "send_backward_recv_backward",
+    "send_forward",
+    "recv_forward",
+    "send_backward",
+    "recv_backward",
+]
+
+
+def _shift(tree: Any, axis: str, offset: int) -> Any:
+    n = lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), tree)
+
+
+def send_forward_recv_forward(tree: Any, *, axis: str = PIPE_AXIS) -> Any:
+    """Rotate activations one stage forward (rank r → r+1, wrapping).
+
+    The returned value on rank r is rank r-1's input; rank 0 receives
+    rank pp-1's (masked out by the schedule)."""
+    return _shift(tree, axis, +1)
+
+
+def send_backward_recv_backward(tree: Any, *, axis: str = PIPE_AXIS) -> Any:
+    """Rotate gradients one stage backward (rank r → r-1, wrapping).
+
+    This is the transpose of :func:`send_forward_recv_forward`, which is
+    why autodiff through the forward schedule yields exactly the
+    reference's backward communication pattern."""
+    return _shift(tree, axis, -1)
+
+
+# Aliases matching the reference's unfused names: on TPU there is no
+# distinction — the collective IS the fused send+recv.
+send_forward = send_forward_recv_forward
+recv_forward = send_forward_recv_forward
+send_backward = send_backward_recv_backward
+recv_backward = send_backward_recv_backward

@@ -1,0 +1,215 @@
+"""Pipeline-parallel schedules — the microbatch engine.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/`` —
+``forward_backward_no_pipelining``, ``_pipelining_without_interleaving``
+(1F1B: warmup fwds, steady one-fwd-one-bwd, cooldown bwds),
+``_pipelining_with_interleaving`` (virtual pipeline), dispatched by
+``get_forward_backward_func()`` (SURVEY.md §3.5).
+
+TPU design — *the schedule is a program, not an event loop*:
+
+- The forward pipeline is a ``lax.scan`` over ``M + pp - 1`` ticks
+  inside ``shard_map`` over the ``pipe`` axis.  Every tick, every stage
+  runs its layer chunk and hands activations to its neighbor with one
+  ``lax.ppermute`` (ICI neighbor exchange).  Dead ticks (pipeline
+  bubble) are masked — they cost exactly the (pp-1)/M bubble of 1F1B.
+- The backward needs no hand-written schedule AT ALL: JAX transposes
+  the scan+ppermute program, yielding the reverse pipeline (cooldown →
+  steady → warmup) with gradients flowing stage-to-stage by the
+  transposed ppermute — the schedule the reference codes by hand in
+  ~2k lines falls out of autodiff.
+- Activation memory: the reference's 1F1B bounds live activations at
+  ``pp`` microbatches by interleaving; here ``jax.checkpoint`` on the
+  stage body bounds residuals to one (mb, seq, hidden) carry per tick,
+  recomputing the stage interior in the transposed pass.
+
+The pipeline spans the homogeneous transformer stack (stage params are
+stacked along a leading ``pp`` axis and split by ``shard_map``);
+embedding/head run outside the pipelined region, as in Megatron's
+``build_model`` stage-embedding special-casing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.core.mesh import PIPE_AXIS
+from apex_tpu.transformer.microbatches import get_num_microbatches
+
+__all__ = [
+    "spmd_pipeline",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "get_forward_backward_func",
+]
+
+
+# --------------------------------------------------------------------- #
+# core: collective SPMD pipeline (inside shard_map)
+# --------------------------------------------------------------------- #
+def spmd_pipeline(
+    stage_fn: Callable,
+    stage_params: Any,
+    microbatches: jnp.ndarray,
+    *,
+    axis: str = PIPE_AXIS,
+    remat: bool = True,
+):
+    """Run ``microbatches`` through a ``pp``-stage pipeline.
+
+    Must be called inside ``shard_map`` with ``axis`` bound.  Per rank:
+    ``stage_params`` is this stage's chunk (leading ``pp`` axis split by
+    the shard_map in_spec); ``microbatches`` is ``(M, mb, seq, hidden)``
+    (replicated; only stage 0 reads it).  ``stage_fn(params, x) -> y``
+    maps ``(mb, seq, hidden) -> (mb, seq, hidden)``.
+
+    Returns ``(M, mb, seq, hidden)`` last-stage outputs, replicated over
+    ``axis`` (masked ``psum`` broadcast).
+    """
+    pp = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    num_micro = microbatches.shape[0]
+    n_ticks = num_micro + pp - 1
+
+    # shard_map's in_spec P(axis) splits the stacked stage axis but
+    # keeps it as a size-1 leading dim — strip it so stage_fn sees the
+    # per-stage parameter shapes
+    stage_params = jax.tree.map(lambda a: a[0], stage_params)
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(
+            stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def tick(carry, t):
+        recv = carry
+        # stage 0 feeds microbatch t (clamped; dead ticks masked out by
+        # the output slice), later stages consume the neighbor's hand-off
+        mb = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, num_micro - 1), axis=0,
+            keepdims=False)
+        x = jnp.where(rank == 0, mb, recv)
+        y = body(stage_params, x)
+        # rotate: rank r's output becomes rank r+1's next input; the
+        # wrap (last -> 0) carries garbage that stage 0 ignores
+        nxt = lax.ppermute(y, axis,
+                           [(i, (i + 1) % pp) for i in range(pp)])
+        return nxt, y
+
+    init = jnp.zeros_like(microbatches[0])
+    # the carry is device-varying over the pipe axis from tick 1 on;
+    # mark the (replicated) zeros accordingly for vma tracking
+    init = lax.pcast(init, (axis,), to="varying")
+    _, ys = lax.scan(tick, init, jnp.arange(n_ticks))
+    # rank pp-1 emits microbatch m at tick m + pp - 1
+    outs = ys[pp - 1:]
+    # replicate the last stage's outputs over the pipe axis (masked
+    # broadcast; transposes to "grads enter at the last stage")
+    outs = lax.psum(
+        jnp.where(rank == pp - 1, outs, jnp.zeros_like(outs)), axis)
+    return outs
+
+
+# --------------------------------------------------------------------- #
+# reference-named drivers
+# --------------------------------------------------------------------- #
+def forward_backward_no_pipelining(
+    forward_step_func: Callable,
+    batch: Any,
+    model_params: Any,
+    *,
+    num_microbatches: Optional[int] = None,
+):
+    """Grad accumulation over microbatches, no pipeline (reference:
+    ``fwd_bwd_no_pipelining.py``).
+
+    ``forward_step_func(params, microbatch) -> scalar loss`` (mean over
+    the microbatch).  ``batch`` is a pytree whose leaves have a leading
+    ``(M * mb)`` dim.  Returns ``(mean_loss, grads)`` — one jit-fused
+    accumulation loop (``lax.scan``), the analogue of the reference's
+    ``no_sync``-until-last-microbatch.
+    """
+    m = num_microbatches or get_num_microbatches()
+    mbs = jax.tree.map(
+        lambda x: x.reshape(m, x.shape[0] // m, *x.shape[1:]), batch)
+
+    grad_fn = jax.value_and_grad(forward_step_func)
+
+    def step(acc, mb):
+        loss, g = grad_fn(model_params, mb)
+        acc_loss, acc_g = acc
+        return (acc_loss + loss,
+                jax.tree.map(jnp.add, acc_g, g)), None
+
+    zero = (jnp.zeros((), jnp.float32),
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                         model_params))
+    (loss_sum, grad_sum), _ = lax.scan(step, zero, mbs)
+    inv = 1.0 / m
+    return loss_sum * inv, jax.tree.map(lambda g: g * inv, grad_sum)
+
+
+def forward_backward_pipelining_without_interleaving(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Any,
+    batch: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    num_microbatches: Optional[int] = None,
+    axis: str = PIPE_AXIS,
+    remat: bool = True,
+    params_spec: Optional[Any] = None,
+):
+    """Pipelined forward+backward (reference: 1F1B,
+    ``fwd_bwd_pipelining_without_interleaving.py``).
+
+    ``stage_fn(stage_params, x) -> y`` is one pipeline stage (its params
+    are ``stage_params`` with the leading ``pp`` axis removed);
+    ``loss_fn(y, microbatch_index) -> scalar`` scores last-stage output.
+    ``batch``: ``(M * mb, seq, hidden)``.  Returns ``(loss, grads)``
+    with ``grads`` matching ``stage_params``.
+    """
+    m = num_microbatches or get_num_microbatches()
+    mbs = batch.reshape(m, batch.shape[0] // m, *batch.shape[1:])
+    pspec = params_spec if params_spec is not None else P(axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=P(),
+        # only `pipe` goes manual: data/tensor axes inside the stage
+        # remain GSPMD-managed, so TP layers compose with the pipeline.
+        # check_vma must stay on — with it off, grad-of-partial-manual
+        # shard_map fails out_specs validation on inferred residuals
+        axis_names={axis})
+    def pipelined_loss(params_local, mbs_local):
+        outs = spmd_pipeline(stage_fn, params_local, mbs_local,
+                             axis=axis, remat=remat)
+        losses = jax.vmap(loss_fn)(outs, jnp.arange(m))
+        return jnp.mean(losses)
+
+    return jax.value_and_grad(pipelined_loss)(stage_params, mbs)
+
+
+def get_forward_backward_func(
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+):
+    """Reference dispatch (``schedules/common.py``): pick the schedule
+    from the pipeline topology."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None \
+                and virtual_pipeline_model_parallel_size > 1:
+            raise NotImplementedError(
+                "interleaved (virtual) pipeline schedule: pending — the "
+                "collective SPMD schedule covers the non-interleaved "
+                "1F1B cost model; virtual stages need the circular "
+                "variant")
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

@@ -1,0 +1,204 @@
+"""Pipeline-parallel schedule tests.
+
+Reference pattern (SURVEY.md §4): the pipeline schedule tests run
+1F1B/interleaved on toy models and compare losses against
+no-pipelining.  Here we do that hermetically on the 8-virtual-device
+CPU mesh — and go further: gradients must match too (the transposed
+schedule is the backward pipeline).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.core.mesh import PIPE_AXIS
+from apex_tpu.transformer import microbatches as mb_lib
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    spmd_pipeline,
+)
+
+HID = 16
+MB = 2          # microbatch size
+SEQ = 4
+
+
+def _stage_fn(params, x):
+    """One pipeline stage: 2-layer MLP block with residual."""
+    w1, b1, w2 = params
+    h = jnp.tanh(x @ w1 + b1)
+    return x + h @ w2
+
+
+def _stacked_params(rng, pp):
+    return (
+        jnp.asarray(rng.normal(size=(pp, HID, HID)) * 0.3, jnp.float32),
+        jnp.asarray(rng.normal(size=(pp, HID)) * 0.1, jnp.float32),
+        jnp.asarray(rng.normal(size=(pp, HID, HID)) * 0.3, jnp.float32),
+    )
+
+
+def _sequential_reference(stacked, batch, m):
+    """Ground truth: run the pp stages sequentially, no pipeline."""
+    pp = stacked[0].shape[0]
+    mbs = batch.reshape(m, -1, SEQ, HID)
+
+    def full_model(stacked, x):
+        for s in range(pp):
+            x = _stage_fn(jax.tree.map(lambda t: t[s], stacked), x)
+        return x
+
+    def loss(stacked):
+        outs = jax.vmap(lambda mb: full_model(stacked, mb))(mbs)
+        return jnp.mean(outs ** 2)
+
+    return jax.value_and_grad(loss)(stacked)
+
+
+class TestPipelineSchedule:
+    @pytest.mark.parametrize("m", [2, 4, 6])
+    def test_matches_sequential(self, rng, mesh8, m):
+        pp = mesh8.shape[PIPE_AXIS]
+        stacked = _stacked_params(rng, pp)
+        batch = jnp.asarray(rng.normal(size=(m * MB, SEQ, HID)),
+                            jnp.float32)
+
+        def loss_fn(y, idx):
+            return jnp.mean(y ** 2)
+
+        loss, grads = forward_backward_pipelining_without_interleaving(
+            _stage_fn, loss_fn, stacked, batch, mesh=mesh8,
+            num_microbatches=m)
+        want_loss, want_grads = _sequential_reference(stacked, batch, m)
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=1e-5)
+        for g, wg in zip(jax.tree.leaves(grads),
+                         jax.tree.leaves(want_grads)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(wg),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_no_remat_matches(self, rng, mesh8):
+        pp = mesh8.shape[PIPE_AXIS]
+        stacked = _stacked_params(rng, pp)
+        batch = jnp.asarray(rng.normal(size=(4 * MB, SEQ, HID)),
+                            jnp.float32)
+
+        def loss_fn(y, idx):
+            return jnp.mean(y ** 2)
+
+        l1, g1 = forward_backward_pipelining_without_interleaving(
+            _stage_fn, loss_fn, stacked, batch, mesh=mesh8,
+            num_microbatches=4, remat=True)
+        l2, g2 = forward_backward_pipelining_without_interleaving(
+            _stage_fn, loss_fn, stacked, batch, mesh=mesh8,
+            num_microbatches=4, remat=False)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_spmd_pipeline_outputs(self, rng, mesh8):
+        """Raw spmd_pipeline: outputs equal the sequential stage stack."""
+        pp = mesh8.shape[PIPE_AXIS]
+        stacked = _stacked_params(rng, pp)
+        m = 3
+        mbs = jnp.asarray(rng.normal(size=(m, MB, SEQ, HID)), jnp.float32)
+
+        outs = jax.jit(jax.shard_map(
+            lambda p, x: spmd_pipeline(_stage_fn, p, x),
+            mesh=mesh8, in_specs=(P(PIPE_AXIS), P()), out_specs=P(),
+            axis_names={PIPE_AXIS}))(stacked, mbs)
+
+        want = mbs
+        for s in range(pp):
+            want = jax.vmap(lambda mb, s=s: _stage_fn(
+                jax.tree.map(lambda t: t[s], stacked), mb))(want)
+        np.testing.assert_allclose(np.asarray(outs), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_no_pipelining_accumulation(self, rng):
+        params = jnp.asarray(rng.normal(size=(HID, HID)), jnp.float32)
+        batch = jnp.asarray(rng.normal(size=(8, HID)), jnp.float32)
+
+        def fwd(p, mb):
+            return jnp.mean((mb @ p) ** 2)
+
+        loss, grads = forward_backward_no_pipelining(
+            fwd, batch, params, num_microbatches=4)
+        want_loss, want_grads = jax.value_and_grad(
+            lambda p: jnp.mean(
+                jax.vmap(lambda mb: fwd(p, mb))(
+                    batch.reshape(4, 2, HID))))(params)
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=1e-6)
+        # scan accumulation vs vmap mean: different summation order
+        np.testing.assert_allclose(np.asarray(grads),
+                                   np.asarray(want_grads), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_dispatch(self):
+        assert get_forward_backward_func(1) is \
+            forward_backward_no_pipelining
+        assert get_forward_backward_func(2) is \
+            forward_backward_pipelining_without_interleaving
+        with pytest.raises(NotImplementedError):
+            get_forward_backward_func(2, 2)
+
+
+class TestMicrobatchCalculator:
+    def test_constant(self):
+        mb_lib.setup_microbatch_calculator(
+            global_batch_size=64, micro_batch_size=4,
+            data_parallel_size=2)
+        assert mb_lib.get_num_microbatches() == 8
+        assert mb_lib.get_current_global_batch_size() == 64
+        mb_lib.update_num_microbatches(10_000)   # no-op for constant
+        assert mb_lib.get_num_microbatches() == 8
+        mb_lib.destroy_microbatch_calculator()
+
+    def test_constant_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            mb_lib.setup_microbatch_calculator(
+                global_batch_size=30, micro_batch_size=4,
+                data_parallel_size=2)
+
+    def test_rampup(self):
+        # 16 -> 64 in +16 steps over 300 samples: 3 increments,
+        # each spanning 100 consumed samples
+        mb_lib.setup_microbatch_calculator(
+            rampup_batch_size=[16, 16, 300],
+            global_batch_size=64, micro_batch_size=4,
+            data_parallel_size=2)
+        assert mb_lib.get_current_global_batch_size() == 16
+        assert mb_lib.get_num_microbatches() == 2
+        mb_lib.update_num_microbatches(150)
+        assert mb_lib.get_current_global_batch_size() == 32
+        mb_lib.update_num_microbatches(301)
+        assert mb_lib.get_current_global_batch_size() == 64
+        assert mb_lib.get_num_microbatches() == 8
+        mb_lib.destroy_microbatch_calculator()
+
+    def test_uninitialized_raises(self):
+        mb_lib.destroy_microbatch_calculator()
+        with pytest.raises(RuntimeError):
+            mb_lib.get_num_microbatches()
+
+
+class TestP2P:
+    def test_forward_shift(self, rng, mesh8):
+        from apex_tpu.transformer.pipeline_parallel import p2p
+
+        pp = mesh8.shape[PIPE_AXIS]
+        x = jnp.arange(pp, dtype=jnp.float32)
+
+        got = jax.jit(jax.shard_map(
+            lambda v: p2p.send_forward_recv_forward(v),
+            mesh=mesh8, in_specs=P(PIPE_AXIS), out_specs=P(PIPE_AXIS),
+            axis_names={PIPE_AXIS}))(x)
+        # rank r receives rank r-1's value (wrap)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.roll(np.arange(pp), 1))
