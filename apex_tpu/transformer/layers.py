@@ -59,7 +59,10 @@ def maybe_constrain(x, *spec):
     spec, so TP/SP constraints compose with any surrounding topology.
     """
     abstract = jax.sharding.get_abstract_mesh()
-    if not abstract.empty:
+    # the abstract-mesh form of the constraint is only legal under a
+    # trace; eagerly (e.g. model.init under jax.set_mesh) fall through
+    # to the concrete-mesh NamedSharding path below
+    if not abstract.empty and isinstance(x, jax.core.Tracer):
         # inside jax.set_mesh / shard_map: resolve against the ambient
         # abstract mesh, keeping only its Auto (GSPMD-managed) axes
         auto = {n for n, t in zip(abstract.axis_names,

@@ -41,6 +41,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.core.mesh import PIPE_AXIS
 from apex_tpu.transformer.microbatches import get_num_microbatches
+from apex_tpu.transformer.pipeline_parallel.p2p import (
+    send_forward_recv_forward,
+)
 
 __all__ = [
     "spmd_pipeline",
@@ -80,6 +83,13 @@ def spmd_pipeline(
     # shard_map's in_spec P(axis) splits the stacked stage axis but
     # keeps it as a size-1 leading dim — strip it so stage_fn sees the
     # per-stage parameter shapes
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] != 1:
+            raise ValueError(
+                f"stage_params' leading (stacked-stage) axis must be "
+                f"split over '{axis}' to local size 1, got local size "
+                f"{leaf.shape[0]} for a {leaf.shape} leaf — pass "
+                f"params_spec=P('{axis}', ...) on every leaf")
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
 
     body = stage_fn
@@ -98,8 +108,7 @@ def spmd_pipeline(
         y = body(stage_params, x)
         # rotate: rank r's output becomes rank r+1's next input; the
         # wrap (last -> 0) carries garbage that stage 0 ignores
-        nxt = lax.ppermute(y, axis,
-                           [(i, (i + 1) % pp) for i in range(pp)])
+        nxt = send_forward_recv_forward(y, axis=axis)
         return nxt, y
 
     init = jnp.zeros_like(microbatches[0])
