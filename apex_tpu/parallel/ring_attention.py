@@ -1,0 +1,200 @@
+"""Ring attention — context parallelism over the ``context`` mesh axis.
+
+**Beyond-reference** (SURVEY.md §2.6 checklist, §5): the reference has
+no context parallelism — Megatron sequence parallelism inside
+``apex.transformer`` shards LN/dropout activations only, and sequence
+length never exceeds one device's attention. On TPU, long context is
+first-class: the sequence dim is sharded over the ``context`` mesh axis
+and the KV shards rotate around the ring on ICI (``lax.ppermute``),
+giving exact attention with O(S/cp) memory per chip and compute that
+overlaps the neighbor exchange (XLA's latency-hiding scheduler runs the
+next-chunk permute concurrently with the current chunk's matmuls).
+
+Algorithm (Liu et al., Ring Attention; flash-style accumulation):
+
+- forward: each of the ``cp`` steps computes the local Q block against
+  the currently-held KV chunk, merging into the running
+  (max, normalizer, accumulator) online-softmax state in fp32; KV then
+  rotates one rank. Saves logsumexp for the backward.
+- backward: a second ring pass. ``dq`` accumulates on the home rank;
+  ``dk``/``dv`` accumulate on buffers that rotate *with* their KV chunk,
+  arriving back at the home rank after the full cycle — the transpose
+  of the forward's communication pattern, made explicit.
+- causal: chunk-level masks from global positions
+  (``rank*s_local + iota``). Under SPMD every rank executes every step,
+  so fully-masked chunk products are computed-then-discarded — the
+  known ~2x causal overhead of plain ring attention; the memory win is
+  what context parallelism is for.
+- GQA: grouped einsums throughout — KV heads are never materialized to
+  ``num_heads`` (same policy as the Pallas kernels in
+  :mod:`apex_tpu.ops.attention`); the group dim sums away naturally in
+  the dk/dv products.
+
+Internally heads are grouped as ``(hk, g)`` with ``g = h // hk`` (g=1
+for plain MHA), so one code path serves both. Layout matches
+:func:`apex_tpu.ops.fused_attention`: (batch, seq_local, heads,
+head_dim).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.core.mesh import CONTEXT_AXIS
+from apex_tpu.ops.attention import _NEG_INF
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+
+def _rotate(tree, axis):
+    n = lax.axis_size(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), tree)
+
+
+def _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk):
+    """fp32 grouped scores (b, hk, g, sq, sk) of the local Q block vs
+    one KV chunk, causally masked from global positions."""
+    s = jnp.einsum("bqhgd,bshd->bhgqs", qg, kc.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if not causal:
+        return s
+    q_pos = rank * sq + jnp.arange(sq)
+    k_pos = src * sk + jnp.arange(sk)
+    dead = k_pos[None, :] > q_pos[:, None]          # (sq, sk)
+    return jnp.where(dead[None, None, None], _NEG_INF, s)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention(q, k, v, axis: str = CONTEXT_AXIS,
+                   causal: bool = False,
+                   scale: Optional[float] = None):
+    """Exact attention over a sequence sharded on mesh axis ``axis``.
+
+    Must be called inside ``shard_map`` (or ``jit`` with the axis
+    manual) with ``axis`` bound; ``q``/``k``/``v`` are the local
+    sequence shards, ``(b, s_local, h|hk, d)``. Returns the local
+    output shard ``(b, s_local, h, d)``. Semantics (incl. GQA and
+    dead-row zeros) match :func:`apex_tpu.ops.attention_reference` on
+    the gathered sequence.
+    """
+    o, _ = _ring_fwd(q, k, v, axis, causal, scale)
+    return o
+
+
+def _ring_fwd(q, k, v, axis, causal, scale):
+    cp = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if h % hk:
+        raise ValueError(
+            f"num_kv_heads ({hk}) must divide num_heads ({h})")
+    g = h // hk
+    scale = (d ** -0.5) if scale is None else float(scale)
+
+    qg = q.astype(jnp.float32).reshape(b, sq, hk, g, d)
+    m = jnp.full((b, hk, g, sq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hk, g, sq), jnp.float32)
+    acc = jnp.zeros((b, sq, hk, g, d), jnp.float32)
+    kv = (k, v)
+    for t in range(cp):
+        kc, vc = kv
+        src = (rank - t) % cp
+        s = _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(s < 0.5 * _NEG_INF, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
+            "bhgqs,bshd->bqhgd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m = m_new
+        kv = _rotate(kv, axis)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = (acc / l_safe.transpose(0, 3, 1, 2)[..., None]
+         ).reshape(b, sq, h, d).astype(q.dtype)
+    lse = m + jnp.log(l_safe)                        # dead rows: ~-inf
+    return o, (q, k, v, o, lse)
+
+
+def _ring_bwd(axis, causal, scale, res, do):
+    q, k, v, o, lse = res
+    cp = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    g = h // hk
+    scale = (d ** -0.5) if scale is None else float(scale)
+
+    qg = q.astype(jnp.float32).reshape(b, sq, hk, g, d)
+    dog = do.astype(jnp.float32).reshape(b, sq, hk, g, d)
+    og = o.astype(jnp.float32).reshape(b, sq, hk, g, d)
+    # delta_i = sum_d dO_i·O_i — the softmax-jacobian row term
+    delta = (dog * og).sum(axis=-1)                  # (b, sq, hk, g)
+    delta = delta.transpose(0, 2, 3, 1)[..., None]   # (b, hk, g, sq, 1)
+    lse_col = lse[..., None]                         # (b, hk, g, sq, 1)
+
+    dq = jnp.zeros((b, sq, hk, g, d), jnp.float32)
+    ring = (k, v,
+            jnp.zeros((b, sk, hk, d), jnp.float32),
+            jnp.zeros((b, sk, hk, d), jnp.float32))
+    for t in range(cp):
+        kc, vc, dkc, dvc = ring
+        src = (rank - t) % cp
+        s = _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk)
+        p = jnp.exp(s - lse_col)
+        # dead positions (incl. fully-dead rows, where lse ~ -inf and
+        # s - lse ~ 0) contribute nothing
+        p = jnp.where(s < 0.5 * _NEG_INF, 0.0, p) if causal else p
+        # the group dim sums away: dv/dk land directly on hk heads
+        dv_c = jnp.einsum("bhgqs,bqhgd->bshd", p, dog,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqhgd,bshd->bhgqs", dog,
+                        vc.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq = dq + jnp.einsum("bhgqs,bshd->bqhgd", ds,
+                             kc.astype(jnp.float32),
+                             preferred_element_type=jnp.float32) * scale
+        dk_c = jnp.einsum("bhgqs,bqhgd->bshd", ds, qg,
+                          preferred_element_type=jnp.float32) * scale
+        ring = _rotate((kc, vc, dkc + dk_c, dvc + dv_c), axis)
+        # cp rotations total: dk/dv buffers arrive back home
+    _, _, dk, dv = ring
+    return (dq.reshape(b, sq, h, d).astype(q.dtype),
+            dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)
+
+
+def ring_self_attention(q, k, v, *, mesh: Mesh,
+                        axis: str = CONTEXT_AXIS,
+                        causal: bool = False,
+                        scale: Optional[float] = None,
+                        batch_spec: Optional[Tuple] = None):
+    """Convenience wrapper: global (b, S, h, d) arrays in, shard_map'd
+    ring attention over ``axis`` inside.
+
+    ``batch_spec`` optionally names a mesh axis for the batch dim (e.g.
+    ``'data'``) so DP×CP compose; other dims are replicated.
+    """
+    bs = batch_spec
+    spec = P(bs, axis, None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, axis_names={axis} | ({bs} if bs else set()))
+    def run(ql, kl, vl):
+        return ring_attention(ql, kl, vl, axis, causal, scale)
+
+    return run(q, k, v)

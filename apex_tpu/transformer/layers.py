@@ -73,10 +73,14 @@ def maybe_constrain(x, *spec):
             return x
         return lax.with_sharding_constraint(
             x, jax.sharding.PartitionSpec(*spec))
-    try:
-        mesh = mesh_lib.get_mesh()
-    except RuntimeError:
-        return x
+    # eager: prefer the ambient jax.set_mesh mesh (concrete form), then
+    # the library-global one
+    mesh = jax.sharding.get_mesh()
+    if mesh.empty:
+        try:
+            mesh = mesh_lib.get_mesh()
+        except RuntimeError:
+            return x
     if mesh.size == 1:
         return x
     sharding = jax.sharding.NamedSharding(

@@ -84,13 +84,15 @@ def spmd_pipeline(
     # keeps it as a size-1 leading dim — strip it so stage_fn sees the
     # per-stage parameter shapes
     for leaf in jax.tree.leaves(stage_params):
-        if leaf.shape[0] != 1:
+        if leaf.ndim and leaf.shape[0] != 1:
             raise ValueError(
                 f"stage_params' leading (stacked-stage) axis must be "
                 f"split over '{axis}' to local size 1, got local size "
                 f"{leaf.shape[0]} for a {leaf.shape} leaf — pass "
                 f"params_spec=P('{axis}', ...) on every leaf")
-    stage_params = jax.tree.map(lambda a: a[0], stage_params)
+    # 0-d leaves are replicated scalars (no stacked axis to strip)
+    stage_params = jax.tree.map(
+        lambda a: a[0] if a.ndim else a, stage_params)
 
     body = stage_fn
     if remat:
