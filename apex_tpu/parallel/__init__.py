@@ -22,6 +22,10 @@ from apex_tpu.parallel.distributed_optim import (
     zero_param_specs,
     zero_shardings,
 )
+from apex_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_self_attention,
+)
 from apex_tpu.optim import LARC
 
 __all__ = [
@@ -30,5 +34,6 @@ __all__ = [
     "SyncBatchNorm", "sync_batch_norm_stats", "convert_syncbn_model",
     "distributed_fused_adam", "distributed_fused_lamb",
     "zero_param_specs", "zero_shardings",
+    "ring_attention", "ring_self_attention",
     "LARC",
 ]

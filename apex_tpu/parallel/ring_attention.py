@@ -58,16 +58,20 @@ def _rotate(tree, axis):
     return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), tree)
 
 
-def _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk):
+def _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk, offset):
     """fp32 grouped scores (b, hk, g, sq, sk) of the local Q block vs
-    one KV chunk, causally masked from global positions."""
+    one KV chunk, causally masked from global positions.
+
+    ``offset = Sk_global - Sq_global`` bottom-aligns the causal mask
+    when key and query lengths differ, matching
+    :func:`apex_tpu.ops.attention_reference`."""
     s = jnp.einsum("bqhgd,bshd->bhgqs", qg, kc.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * scale
     if not causal:
         return s
     q_pos = rank * sq + jnp.arange(sq)
     k_pos = src * sk + jnp.arange(sk)
-    dead = k_pos[None, :] > q_pos[:, None]          # (sq, sk)
+    dead = k_pos[None, :] > q_pos[:, None] + offset  # (sq, sk)
     return jnp.where(dead[None, None, None], _NEG_INF, s)
 
 
@@ -103,11 +107,13 @@ def _ring_fwd(q, k, v, axis, causal, scale):
     m = jnp.full((b, hk, g, sq), _NEG_INF, jnp.float32)
     l = jnp.zeros((b, hk, g, sq), jnp.float32)
     acc = jnp.zeros((b, sq, hk, g, d), jnp.float32)
+    offset = cp * (sk - sq)                          # Sk_glob - Sq_glob
     kv = (k, v)
     for t in range(cp):
         kc, vc = kv
         src = (rank - t) % cp
-        s = _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk)
+        s = _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk,
+                          offset)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[..., None])
         if causal:
@@ -144,13 +150,15 @@ def _ring_bwd(axis, causal, scale, res, do):
     lse_col = lse[..., None]                         # (b, hk, g, sq, 1)
 
     dq = jnp.zeros((b, sq, hk, g, d), jnp.float32)
+    offset = cp * (sk - sq)                          # Sk_glob - Sq_glob
     ring = (k, v,
             jnp.zeros((b, sk, hk, d), jnp.float32),
             jnp.zeros((b, sk, hk, d), jnp.float32))
     for t in range(cp):
         kc, vc, dkc, dvc = ring
         src = (rank - t) % cp
-        s = _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk)
+        s = _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk,
+                          offset)
         p = jnp.exp(s - lse_col)
         # dead positions (incl. fully-dead rows, where lse ~ -inf and
         # s - lse ~ 0) contribute nothing

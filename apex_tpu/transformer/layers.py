@@ -74,14 +74,28 @@ def maybe_constrain(x, *spec):
         return lax.with_sharding_constraint(
             x, jax.sharding.PartitionSpec(*spec))
     # eager: prefer the ambient jax.set_mesh mesh (concrete form), then
-    # the library-global one
-    mesh = jax.sharding.get_mesh()
-    if mesh.empty:
+    # the library-global one.  Under a trace with no ambient abstract
+    # mesh (plain jit), jax.sharding.get_mesh() raises — skip straight
+    # to the library-global mesh, whose concrete NamedSharding is legal
+    # inside jit.
+    try:
+        mesh = jax.sharding.get_mesh()
+        if mesh.empty:
+            mesh = None
+    except ValueError:
+        mesh = None
+    if mesh is None:
         try:
             mesh = mesh_lib.get_mesh()
         except RuntimeError:
             return x
     if mesh.size == 1:
+        return x
+    # drop axes absent from this mesh (e.g. a user mesh with foreign
+    # axis names) so the constraint degrades instead of erroring
+    names = set(mesh.axis_names)
+    spec = tuple(s if s in names else None for s in spec)
+    if all(s is None for s in spec):
         return x
     sharding = jax.sharding.NamedSharding(
         mesh, jax.sharding.PartitionSpec(*spec))
