@@ -1,0 +1,114 @@
+"""Fused ResNet bottleneck block (+ spatial-parallel variant).
+
+Reference: ``apex/contrib/bottleneck`` (+ csrc, cudnn-frontend) — the
+1x1/3x3/1x1 ResNet bottleneck as one fused graph (conv+BN+ReLU chains,
+residual add folded into the last ReLU), plus ``SpatialBottleneck``
+which partitions H across GPUs and halo-exchanges the 3x3 conv's
+boundary rows via ``peer_memory``.
+
+TPU design: under jit the whole block is one XLA computation — the
+conv+scale+shift+relu chains and the residual epilogue fuse without
+hand-written graphs, so the value here is (a) the frozen-BN folding the
+reference does (BN as precomputed scale/shift) and (b) the
+spatial-parallel 3x3 with ``halo_exchange`` over the mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.contrib.conv_bias_relu import _conv2d_nhwc
+from apex_tpu.contrib.peer_memory import halo_exchange
+
+__all__ = ["Bottleneck", "SpatialBottleneck"]
+
+
+class _ConvScaleShift(nn.Module):
+    """Conv + folded-BN scale/shift (+ optional ReLU) — the fused unit.
+
+    The reference folds inference-mode BN into per-channel scale/shift
+    applied in the conv epilogue ("conv-scale-bias-relu" cudnn graph);
+    training-mode BN belongs to the caller's norm layer of choice.
+    """
+
+    features: int
+    kernel_size: int = 1
+    stride: int = 1
+    relu: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        ks = (self.kernel_size, self.kernel_size)
+        kernel = self.param("kernel", nn.initializers.he_normal(),
+                            (*ks, x.shape[-1], self.features),
+                            self.param_dtype)
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (self.features,), self.param_dtype)
+        shift = self.param("shift", nn.initializers.zeros_init(),
+                           (self.features,), self.param_dtype)
+        y = _conv2d_nhwc(x, kernel, self.stride,
+                         "SAME" if self.kernel_size > 1 else "VALID")
+        y = y * scale.astype(jnp.float32) + shift.astype(jnp.float32)
+        if self.relu:
+            y = jnp.maximum(y, 0.0)
+        return y.astype(x.dtype)
+
+
+class Bottleneck(nn.Module):
+    """ResNet bottleneck: 1x1 → 3x3 (stride) → 1x1 + residual ReLU."""
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        r = _ConvScaleShift(self.bottleneck_channels, 1,
+                            param_dtype=self.param_dtype, name="conv1")(x)
+        r = self._conv2(r)
+        r = _ConvScaleShift(self.out_channels, 1, relu=False,
+                            param_dtype=self.param_dtype, name="conv3")(r)
+        if self.stride != 1 or self.in_channels != self.out_channels:
+            x = _ConvScaleShift(self.out_channels, 1, self.stride,
+                                relu=False, param_dtype=self.param_dtype,
+                                name="downsample")(x)
+        return jnp.maximum(r.astype(jnp.float32) + x.astype(jnp.float32),
+                           0.0).astype(x.dtype)
+
+    def _conv2(self, r):
+        return _ConvScaleShift(self.bottleneck_channels, 3, self.stride,
+                               param_dtype=self.param_dtype,
+                               name="conv2")(r)
+
+
+class SpatialBottleneck(Bottleneck):
+    """Bottleneck with H partitioned over mesh axis ``spatial_axis``.
+
+    The 3x3 conv needs one halo row from each neighbor; everything else
+    is pointwise in H.  Must run inside ``shard_map`` over the axis.
+    Parity: ``apex/contrib/bottleneck`` ``SpatialBottleneck`` with
+    ``peer_memory`` halo push/pull.
+    """
+
+    spatial_axis: str = "context"
+
+    def _conv2(self, r):
+        if self.stride != 1:
+            raise NotImplementedError(
+                "spatial-parallel bottleneck requires stride 1 in the "
+                "partitioned dimension (reference limitation as well)")
+        r = halo_exchange(r, axis_name=self.spatial_axis, halo=1,
+                          spatial_dim=1)
+        y = _ConvScaleShift(self.bottleneck_channels, 3, 1,
+                            param_dtype=self.param_dtype,
+                            name="conv2")(r)
+        # 'SAME' padding on the haloed input grows H by 2; crop the halo
+        # rows back off (they were only context for the boundary rows).
+        return jax.lax.slice_in_dim(y, 1, y.shape[1] - 1, axis=1)

@@ -1,0 +1,50 @@
+"""FMHA — fixed-pattern fused multi-head attention (contrib parity).
+
+Reference: ``apex/contrib/fmha`` (+ ``csrc/fmha``) — a pre-FlashAttn
+fp16 fused MHA limited to seq-len buckets ≤512, taking packed varlen
+QKV with cumulative sequence lengths.
+
+TPU design: fully subsumed by the Pallas flash-attention kernel in
+``apex_tpu.ops.attention`` (no bucket limit, bf16-first, fwd+bwd).
+This module keeps the contrib entry point and provides the varlen
+(cu_seqlens) calling convention on top of the dense kernel by masking —
+XLA's static shapes make true packing a layout choice, not a kernel
+requirement.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import fused_attention, mask_to_bias
+
+__all__ = ["fmha", "FMHAFun"]
+
+
+def fmha(qkv, cu_seqlens=None, *, causal: bool = False, max_s=None,
+         implementation=None):
+    """Fused MHA over packed ``qkv`` (B, S, 3, H, D).
+
+    ``cu_seqlens``: optional (B+1,) cumulative lengths; positions past
+    each sequence's length are masked (parity with the reference's
+    varlen path, expressed as masking over the padded batch).
+    """
+    q, k, v = (qkv[:, :, i] for i in range(3))
+    bias = None
+    if cu_seqlens is not None:
+        lens = cu_seqlens[1:] - cu_seqlens[:-1]          # (B,)
+        pos = jnp.arange(q.shape[1])
+        pad = pos[None, :] >= lens[:, None]              # (B, S) True=pad
+        bias = mask_to_bias(pad)[:, None, None, :]       # (B,1,1,Sk)
+    return fused_attention(q, k, v, causal=causal, bias=bias,
+                           implementation=implementation)
+
+
+class FMHAFun:
+    """Object form mirroring the reference's autograd-function entry."""
+
+    def __init__(self, causal: bool = False):
+        self.causal = causal
+
+    def __call__(self, qkv, cu_seqlens=None, max_s=None):
+        return fmha(qkv, cu_seqlens, causal=self.causal, max_s=max_s)

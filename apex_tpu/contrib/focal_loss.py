@@ -1,0 +1,77 @@
+"""Fused focal loss for dense detection workloads.
+
+Reference: ``apex/contrib/focal_loss`` (+ ``apex/contrib/csrc/focal_loss``)
+— a fused CUDA kernel computing sigmoid focal loss over the anchor
+classification head of SSD-style detectors, with label smoothing and the
+normalizer folded in.
+
+TPU design: the whole loss is one traced elementwise region over the
+(num_anchors, num_classes) logit tensor; XLA fuses the sigmoid, the
+focusing term and the reduction into a single pass over HBM, which is
+exactly what the reference's kernel buys on CUDA.  No Pallas needed —
+there is no cross-row data reuse to exploit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sigmoid_focal_loss", "focal_loss_reference", "FocalLoss"]
+
+
+def focal_loss_reference(logits, targets, *, num_classes: int,
+                         alpha: float = 0.25, gamma: float = 2.0,
+                         smoothing: float = 0.0):
+    """Eager composition (golden reference for the fused path).
+
+    ``logits``: (..., num_classes) raw scores.  ``targets``: (...,) int
+    class ids in [0, num_classes); background/ignored anchors are
+    encoded as targets < 0 (contribute only background loss for -1,
+    fully ignored for -2, mirroring the reference's convention).
+    """
+    t = targets[..., None]
+    onehot = (jnp.arange(num_classes) == t).astype(jnp.float32)
+    if smoothing > 0.0:
+        onehot = onehot * (1.0 - smoothing) + smoothing / num_classes
+    valid = (targets >= -1)[..., None].astype(jnp.float32)
+    onehot = jnp.where(t >= 0, onehot, 0.0)
+
+    x = logits.astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * onehot + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * onehot + (1.0 - p) * (1.0 - onehot)
+    alpha_t = alpha * onehot + (1.0 - alpha) * (1.0 - onehot)
+    loss = alpha_t * ((1.0 - p_t) ** gamma) * ce * valid
+    return loss
+
+
+def sigmoid_focal_loss(logits, targets, *, num_classes: int,
+                       alpha: float = 0.25, gamma: float = 2.0,
+                       smoothing: float = 0.0, normalizer=1.0):
+    """Sigmoid focal loss, summed and divided by ``normalizer``.
+
+    Parity: ``apex.contrib.focal_loss.focal_loss.FocalLoss.apply`` —
+    one fused pass, scalar output.  Differentiable w.r.t. ``logits``.
+    """
+    loss = focal_loss_reference(
+        logits, targets, num_classes=num_classes, alpha=alpha,
+        gamma=gamma, smoothing=smoothing)
+    return jnp.sum(loss) / normalizer
+
+
+class FocalLoss:
+    """Object form keeping the reference's constructor signature."""
+
+    def __init__(self, num_classes: int, alpha: float = 0.25,
+                 gamma: float = 2.0, smoothing: float = 0.0):
+        self.num_classes = num_classes
+        self.alpha = alpha
+        self.gamma = gamma
+        self.smoothing = smoothing
+
+    def __call__(self, logits, targets, normalizer=1.0):
+        return sigmoid_focal_loss(
+            logits, targets, num_classes=self.num_classes,
+            alpha=self.alpha, gamma=self.gamma,
+            smoothing=self.smoothing, normalizer=normalizer)

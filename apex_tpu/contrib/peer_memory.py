@@ -1,0 +1,75 @@
+"""Neighbor halo exchange for spatial-parallel convolutions.
+
+Reference: ``apex/contrib/peer_memory`` (+ csrc) — CUDA IPC peer-memory
+pools plus push/pull halo-exchange kernels that let adjacent GPUs swap
+the boundary rows of a spatially-partitioned activation tensor.
+
+TPU design: ICI *is* the peer fabric and the compiler owns buffer
+placement, so the pool machinery (``PeerMemoryPool``) is unnecessary —
+what survives is the collective pattern: each shard sends its top/bottom
+halo rows to its spatial neighbors with two ``lax.ppermute`` shifts over
+the mesh axis that partitions H.  Under jit the sends are fused into the
+surrounding computation exactly like the reference's side-stream pushes.
+
+Used by ``apex_tpu.contrib.bottleneck.SpatialBottleneck``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["halo_exchange", "PeerHaloExchanger"]
+
+
+def halo_exchange(x, *, axis_name: str, halo: int, spatial_dim: int = 1,
+                  wrap: bool = False):
+    """Exchange ``halo`` boundary slices with mesh-axis neighbors.
+
+    ``x``: the local shard of an activation tensor, partitioned along
+    ``spatial_dim`` (default 1 = H of NHWC) across mesh axis
+    ``axis_name``.  Returns ``x`` padded with the neighbors' halos:
+    ``x.shape[spatial_dim] + 2*halo`` (edge shards get zero padding
+    unless ``wrap``).
+
+    Parity: ``PeerHaloExchanger1d.__call__`` (push-pull of top/bottom
+    halo rows between adjacent ranks).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+
+    top = lax.slice_in_dim(x, 0, halo, axis=spatial_dim)
+    bot = lax.slice_in_dim(x, x.shape[spatial_dim] - halo,
+                           x.shape[spatial_dim], axis=spatial_dim)
+
+    # Send my bottom rows down (they become the lower neighbor's top
+    # halo) and my top rows up.
+    perm_down = [(i, (i + 1) % n) for i in range(n)]
+    perm_up = [(i, (i - 1) % n) for i in range(n)]
+    from_above = lax.ppermute(bot, axis_name, perm_down)
+    from_below = lax.ppermute(top, axis_name, perm_up)
+
+    if not wrap:
+        zero = jnp.zeros_like(top)
+        from_above = jnp.where(idx == 0, zero, from_above)
+        from_below = jnp.where(idx == n - 1, zero, from_below)
+
+    return jnp.concatenate([from_above, x, from_below], axis=spatial_dim)
+
+
+class PeerHaloExchanger:
+    """Object form mirroring ``PeerHaloExchanger1d``."""
+
+    def __init__(self, axis_name: str, halo: int, spatial_dim: int = 1,
+                 wrap: bool = False):
+        self.axis_name = axis_name
+        self.halo = halo
+        self.spatial_dim = spatial_dim
+        self.wrap = wrap
+
+    def __call__(self, x):
+        return halo_exchange(x, axis_name=self.axis_name, halo=self.halo,
+                             spatial_dim=self.spatial_dim, wrap=self.wrap)

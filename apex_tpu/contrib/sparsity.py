@@ -1,0 +1,124 @@
+"""ASP — automatic 2:4 structured sparsity (mask search + masked step).
+
+Reference: ``apex/contrib/sparsity`` — ``ASP.prune_trained_model``:
+magnitude-based 2:4 mask search over eligible weights, optimizer
+patching so every step re-applies the masks, and an offline channel
+permutation search that improves which magnitudes survive.
+
+TPU caveat (documented N/A-with-rationale, SURVEY.md §2.7): TPUs have
+no 2:4 sparse matrix hardware, so masked weights buy no FLOPs — the
+masks here reproduce the *algorithm* (for training sparse networks and
+for exporting to hardware that does accelerate 2:4), not a speedup.
+
+Design: functional — ``compute_masks(params)`` returns a mask pytree,
+``apply_masks`` zeroes params, and ``masked(tx, masks)`` wraps any
+optax transformation so updates are masked (the reference patches
+``optimizer.step``; we wrap the GradientTransformation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["mask_2to4", "compute_masks", "apply_masks", "masked",
+           "sparsity_ratio", "permute_columns_for_sparsity"]
+
+
+def mask_2to4(w) -> jax.Array:
+    """Keep the 2 largest-|w| of every 4 consecutive input weights.
+
+    Operates along the *first* (input/reduction) axis groups of a 2-D
+    weight, matching the reference's m4n2_1d magnitude pattern on the
+    GEMM reduction dimension.
+    """
+    if w.ndim < 2 or w.shape[0] % 4 != 0:
+        return jnp.ones_like(w, dtype=jnp.bool_)
+    g = w.reshape(w.shape[0] // 4, 4, *w.shape[1:])
+    mag = jnp.abs(g)
+    # rank within each group of 4: keep top-2
+    order = jnp.argsort(jnp.argsort(-mag, axis=1), axis=1)
+    mask = order < 2
+    return mask.reshape(w.shape)
+
+
+def _eligible(path, leaf) -> bool:
+    if leaf.ndim < 2:
+        return False
+    name = "/".join(str(p) for p in path).lower()
+    if "embed" in name or "norm" in name or "bias" in name:
+        return False
+    return leaf.shape[0] % 4 == 0
+
+
+def compute_masks(params, *, is_eligible: Optional[Callable] = None):
+    """2:4 masks for every eligible weight; all-ones elsewhere.
+
+    Parity: ``ASP.compute_sparse_masks`` (whitelist = 2-D GEMM weights,
+    skip embeddings/norms/biases).
+    """
+    pred = is_eligible or _eligible
+
+    def one(path, leaf):
+        if pred(path, leaf):
+            return mask_2to4(leaf)
+        return jnp.ones_like(leaf, dtype=jnp.bool_)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def apply_masks(params, masks):
+    """Zero out pruned weights (``ASP``'s in-place mask application)."""
+    return jax.tree_util.tree_map(
+        lambda p, m: jnp.where(m, p, jnp.zeros_like(p)), params, masks)
+
+
+def masked(tx: optax.GradientTransformation,
+           masks: Any) -> optax.GradientTransformation:
+    """Wrap an optimizer so pruned coordinates never receive updates.
+
+    Parity: the reference's patched ``optimizer.step`` which re-applies
+    masks to weights (and grads) every step, keeping pruned weights at
+    exactly zero through training.
+    """
+
+    def init(params):
+        return tx.init(apply_masks(params, masks))
+
+    def update(grads, state, params=None):
+        grads = apply_masks(grads, masks)
+        updates, state = tx.update(grads, state, params)
+        updates = apply_masks(updates, masks)
+        return updates, state
+
+    return optax.GradientTransformation(init, update)
+
+
+def sparsity_ratio(masks) -> jax.Array:
+    """Fraction of pruned weights (diagnostic)."""
+    zeros = sum(jnp.sum(~m) for m in jax.tree_util.tree_leaves(masks))
+    total = sum(m.size for m in jax.tree_util.tree_leaves(masks))
+    return zeros / total
+
+
+def permute_columns_for_sparsity(w):
+    """Greedy column-permutation search raising kept magnitude.
+
+    Reference: ``apex/contrib/sparsity/permutation_search_kernels`` —
+    permuting GEMM columns (rows of ``w`` here) changes which weights
+    fall in the same group of 4, so a search can raise the total
+    magnitude surviving 2:4 pruning.  This implements the cheap
+    bounded-regret variant: sort rows by norm and deal them round-robin
+    so large rows spread across groups.  Returns (permutation,
+    w_permuted).
+    """
+    if w.ndim < 2 or w.shape[0] % 4 != 0:
+        return jnp.arange(w.shape[0]), w
+    norms = jnp.sum(jnp.abs(w.reshape(w.shape[0], -1)), axis=1)
+    order = jnp.argsort(-norms)
+    n = w.shape[0]
+    perm = order.reshape(4, n // 4).T.reshape(-1)
+    return perm, w[perm]
