@@ -41,6 +41,7 @@ from apex_tpu.core.mesh import (
 
 from apex_tpu import amp
 from apex_tpu import core
+from apex_tpu import fp16_utils
 from apex_tpu import models
 from apex_tpu import ops
 from apex_tpu import optim
@@ -62,6 +63,7 @@ __all__ = [
     "destroy_mesh",
     "amp",
     "core",
+    "fp16_utils",
     "ops",
     "optim",
     "parallel",

@@ -1,0 +1,181 @@
+"""apex_tpu.fp16_utils — the legacy manual-fp16 API, functional.
+
+Reference: ``apex/fp16_utils/{fp16_optimizer,fp16util,loss_scaler}.py``
+— the pre-amp workflow: convert the network to half by hand, keep fp32
+master weights inside ``FP16_Optimizer``, scale the loss, copy model
+grads to master grads, step on the masters, copy back.
+
+TPU translation: the same five verbs as pure pytree functions, and
+``FP16_Optimizer`` as a thin stateful-API-shaped facade whose ``init``/
+``step`` are pure (state in, state out) so the whole step jits.  All of
+it is subsumed by :mod:`apex_tpu.amp` (SURVEY.md §2.1 "legacy" row);
+kept for API parity with code written against ``fp16_utils``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.core.loss_scale import (
+    DynamicLossScale,
+    LossScaleState,
+    NoOpLossScale,
+    StaticLossScale,
+    all_finite,
+)
+from apex_tpu.core.precision import _default_bn_filter, tree_cast
+
+__all__ = [
+    "network_to_half", "BN_convert_float",
+    "master_params_to_model_params", "model_grads_to_master_grads",
+    "prep_param_lists", "FP16_Optimizer", "FP16OptimizerState",
+    "LossScaler", "DynamicLossScaler",
+]
+
+
+def network_to_half(params: Any, *, half_dtype=jnp.float16) -> Any:
+    """Cast floating leaves to half, keeping norm-layer leaves fp32.
+
+    Parity: ``fp16util.network_to_half`` (whose BN2 wrapper keeps
+    BatchNorm in fp32 — here the BN path filter does the same job).
+    """
+    return tree_cast(params, half_dtype, keep_fp32_filter=_default_bn_filter)
+
+
+def BN_convert_float(params: Any) -> Any:
+    """Cast norm-layer leaves back to fp32 (``fp16util.BN_convert_float``)."""
+
+    def _cast(path, leaf):
+        if _default_bn_filter(path, leaf) and jnp.issubdtype(
+                jnp.asarray(leaf).dtype, jnp.floating):
+            return jnp.asarray(leaf, jnp.float32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(_cast, params)
+
+
+def prep_param_lists(params: Any) -> Tuple[Any, Any]:
+    """(model_params, fp32 master copies) — ``fp16util.prep_param_lists``."""
+    masters = jax.tree.map(
+        lambda p: jnp.asarray(p, jnp.float32)
+        if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p,
+        params)
+    return params, masters
+
+
+def master_params_to_model_params(model_params: Any,
+                                  master_params: Any) -> Any:
+    """Round fp32 masters into the model params' dtypes."""
+    return jax.tree.map(
+        lambda p, m: m.astype(jnp.asarray(p).dtype), model_params,
+        master_params)
+
+
+def model_grads_to_master_grads(model_grads: Any) -> Any:
+    """Upcast half model grads to fp32 master grads."""
+    return jax.tree.map(
+        lambda g: jnp.asarray(g, jnp.float32)
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) else g,
+        model_grads)
+
+
+# --------------------------------------------------------------------- #
+# legacy loss scalers (constructor-arg parity with fp16_utils)
+# --------------------------------------------------------------------- #
+def LossScaler(scale: float = 1.0) -> StaticLossScale:
+    """Static scaler (``fp16_utils.LossScaler``)."""
+    return StaticLossScale(scale=scale)
+
+
+def DynamicLossScaler(init_scale: float = 2.0 ** 32,
+                      scale_factor: float = 2.0,
+                      scale_window: int = 1000) -> DynamicLossScale:
+    """Dynamic scaler with the legacy module's defaults/arg names."""
+    return DynamicLossScale(init_scale=init_scale,
+                            growth_factor=scale_factor,
+                            backoff_factor=1.0 / scale_factor,
+                            growth_interval=scale_window)
+
+
+class FP16OptimizerState(NamedTuple):
+    master_params: Any
+    opt_state: Any
+    loss_scale_state: LossScaleState
+
+
+class FP16_Optimizer:
+    """Master-weight wrapper (``fp16_utils.FP16_Optimizer`` parity).
+
+    Pure-functional shape: ``state = opt.init(model_params)``;
+    ``new_state, model_params, finite = opt.step(state, model_params,
+    model_grads)``.  The step unscales, checks finiteness, updates the
+    fp32 masters (skipping on overflow like the reference), rounds them
+    back into the model params, and adjusts the dynamic scale.
+    """
+
+    def __init__(self, tx: optax.GradientTransformation,
+                 static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None):
+        self.tx = tx
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(
+                **(dynamic_loss_args or {}))
+        elif static_loss_scale == 1.0:
+            self.loss_scaler = NoOpLossScale()
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+
+    def init(self, model_params: Any) -> FP16OptimizerState:
+        _, masters = prep_param_lists(model_params)
+        return FP16OptimizerState(
+            master_params=masters,
+            opt_state=self.tx.init(masters),
+            loss_scale_state=self.loss_scaler.init(),
+        )
+
+    def scale_loss(self, state: FP16OptimizerState, loss: Any) -> Any:
+        """``optimizer.backward(loss)``'s scaling half, as a function."""
+        return self.loss_scaler.scale(state.loss_scale_state, loss)
+
+    def step(self, state: FP16OptimizerState, model_params: Any,
+             model_grads: Any):
+        ls, ls_state = self.loss_scaler, state.loss_scale_state
+        grads = model_grads_to_master_grads(model_grads)
+        grads = ls.unscale(ls_state, grads)
+        finite = all_finite(grads)
+        updates, new_opt_state = self.tx.update(
+            grads, state.opt_state, state.master_params)
+        new_masters = optax.apply_updates(state.master_params, updates)
+        new_masters = ls.select_step(finite, new_masters,
+                                     state.master_params)
+        new_opt_state = ls.select_step(finite, new_opt_state,
+                                       state.opt_state)
+        new_state = FP16OptimizerState(
+            master_params=new_masters,
+            opt_state=new_opt_state,
+            loss_scale_state=ls.adjust(ls_state, finite),
+        )
+        new_model = master_params_to_model_params(model_params,
+                                                  new_masters)
+        return new_state, new_model, finite
+
+    # persistence parity (fp16_optimizer state_dict keeps scaler state)
+    def state_dict(self, state: FP16OptimizerState) -> dict:
+        return {
+            "loss_scaler": state.loss_scale_state.state_dict(),
+            "master_params": state.master_params,
+            "opt_state": state.opt_state,
+        }
+
+    def load_state_dict(self, d: dict) -> FP16OptimizerState:
+        return FP16OptimizerState(
+            master_params=d["master_params"],
+            opt_state=d["opt_state"],
+            loss_scale_state=LossScaleState.from_state_dict(
+                d["loss_scaler"]),
+        )

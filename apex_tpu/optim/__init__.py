@@ -16,6 +16,10 @@ from apex_tpu.optim.fused_lamb import fused_lamb, FusedLambState
 from apex_tpu.optim.fused_sgd import fused_sgd, FusedSgdState
 from apex_tpu.optim.fused_novograd import fused_novograd, FusedNovoGradState
 from apex_tpu.optim.fused_adagrad import fused_adagrad, FusedAdagradState
+from apex_tpu.optim.fused_mixed_precision_lamb import (
+    fused_mixed_precision_lamb,
+    FusedMixedPrecisionLambState,
+)
 from apex_tpu.optim.larc import larc
 from apex_tpu.optim.clip import clip_grad_norm, clip_by_global_norm
 from apex_tpu.optim._multi_tensor import (
@@ -33,6 +37,7 @@ FusedSGD = fused_sgd
 FusedNovoGrad = fused_novograd
 FusedAdagrad = fused_adagrad
 LARC = larc
+FusedMixedPrecisionLamb = fused_mixed_precision_lamb
 
 __all__ = [
     "fused_adam", "FusedAdamState", "FusedAdam",
@@ -41,6 +46,8 @@ __all__ = [
     "fused_novograd", "FusedNovoGradState", "FusedNovoGrad",
     "fused_adagrad", "FusedAdagradState", "FusedAdagrad",
     "larc", "LARC",
+    "fused_mixed_precision_lamb", "FusedMixedPrecisionLambState",
+    "FusedMixedPrecisionLamb",
     "clip_grad_norm", "clip_by_global_norm",
     "tree_l2_norm", "per_tensor_l2_norms", "tree_scale", "tree_axpby",
     "global_grad_clip_coef",

@@ -1,0 +1,74 @@
+"""LAMB with fp32 master state for half-precision model params.
+
+Reference: ``apex/optimizers/fused_mixed_precision_lamb.py`` — a LAMB
+variant whose exp_avg/exp_avg_sq *and* a master copy of the params live
+in fp32 while the model runs bf16/fp16; each step updates the masters
+and writes the rounded copy back to the model params.
+
+TPU design: an optax wrapper whose state carries the fp32 masters plus
+the inner :func:`apex_tpu.optim.fused_lamb` state.  The emitted update
+is ``cast(new_master) - param`` so that after ``optax.apply_updates``
+the model params are exactly the rounded masters — the whole step is
+one fused jit region over the pytree (amp_C parity, SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optim.fused_lamb import fused_lamb
+
+__all__ = ["fused_mixed_precision_lamb", "FusedMixedPrecisionLambState"]
+
+
+class FusedMixedPrecisionLambState(NamedTuple):
+    master_params: Any           # fp32 copies of the model params
+    inner: Any                   # FusedLambState over the masters
+
+
+def fused_mixed_precision_lamb(
+    learning_rate: Any = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    max_grad_norm: Optional[float] = 1.0,
+    **lamb_kwargs: Any,
+) -> optax.GradientTransformation:
+    """LAMB over fp32 masters for half model params (drop-in optax tx)."""
+    inner = fused_lamb(learning_rate, b1=b1, b2=b2, eps=eps,
+                       weight_decay=weight_decay,
+                       max_grad_norm=max_grad_norm, **lamb_kwargs)
+
+    def _to_master(p):
+        if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating):
+            return jnp.asarray(p, jnp.float32)
+        return p
+
+    def init(params):
+        masters = jax.tree.map(_to_master, params)
+        return FusedMixedPrecisionLambState(masters, inner.init(masters))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError(
+                "fused_mixed_precision_lamb requires params "
+                "(the half-precision model params)")
+        fgrads = jax.tree.map(_to_master, grads)
+        updates, new_inner = inner.update(fgrads, state.inner,
+                                          state.master_params)
+        new_masters = optax.apply_updates(state.master_params, updates)
+        # model param update = master - param, kept in fp32: apply_updates
+        # adds in the promoted (fp32) dtype then casts to the param dtype,
+        # so the applied params are exactly the rounded masters (a half-
+        # precision difference would lose the low bits across binades).
+        model_updates = jax.tree.map(
+            lambda m, p: m - p.astype(jnp.float32), new_masters, params)
+        return model_updates, FusedMixedPrecisionLambState(
+            new_masters, new_inner)
+
+    return optax.GradientTransformation(init, update)

@@ -9,6 +9,7 @@ from apex_tpu.utils.tree import (
     tree_select,
     global_grad_clip_coef,
 )
+from apex_tpu.utils.flatten import flatten, unflatten
 
 __all__ = [
     "is_floating",
@@ -18,4 +19,6 @@ __all__ = [
     "tree_axpby",
     "tree_select",
     "global_grad_clip_coef",
+    "flatten",
+    "unflatten",
 ]

@@ -1,0 +1,39 @@
+"""Flatten/unflatten a pytree into one contiguous buffer.
+
+Reference: ``csrc/flatten_unflatten.cpp`` (``apex_C.flatten`` /
+``apex_C.unflatten``) — used by the reference's DDP gradient buckets and
+fp16 master-param flattening.
+
+TPU note: XLA fuses pytree-wide elementwise work without manual
+flattening (SURVEY.md §2.1), so this exists for API parity and for the
+rare case where a single contiguous buffer is genuinely wanted (e.g.
+hashing a whole param tree, or host-side IO).  Built on
+``jax.flatten_util.ravel_pytree``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+__all__ = ["flatten", "unflatten"]
+
+
+def flatten(tree: Any) -> Tuple[jnp.ndarray, Callable[[jnp.ndarray], Any]]:
+    """Pack all leaves into one 1-D buffer; returns (buffer, unravel).
+
+    ``apex_C.flatten`` parity — the inverse comes back as a closure
+    (carrying shapes/dtypes) instead of requiring the original tensor
+    list like the reference's ``unflatten(flat, tensors)``.
+    """
+    return ravel_pytree(tree)
+
+
+def unflatten(flat: jnp.ndarray, like: Any) -> Any:
+    """Unpack ``flat`` into the structure/shapes/dtypes of ``like``
+    (``apex_C.unflatten(flat, tensors)`` parity)."""
+    _, unravel = ravel_pytree(like)
+    return unravel(flat)

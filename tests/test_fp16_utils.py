@@ -1,0 +1,153 @@
+"""Legacy fp16_utils API + FusedMixedPrecisionLamb + flatten parity —
+mirror of the reference's ``tests/L0/run_fp16util`` (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apex_tpu import fp16_utils, optim, utils
+
+
+def _params(rng):
+    return {
+        "dense": {"kernel": jnp.asarray(rng.normal(size=(8, 4)),
+                                        jnp.float32),
+                  "bias": jnp.zeros((4,), jnp.float32)},
+        "batchnorm_0": {"scale": jnp.ones((4,), jnp.float32)},
+        "step": jnp.asarray(3, jnp.int32),
+    }
+
+
+class TestConversions:
+    def test_network_to_half_keeps_bn_fp32(self, rng):
+        p = _params(rng)
+        h = fp16_utils.network_to_half(p)
+        assert h["dense"]["kernel"].dtype == jnp.float16
+        assert h["batchnorm_0"]["scale"].dtype == jnp.float32
+        assert h["step"].dtype == jnp.int32
+
+    def test_bn_convert_float(self, rng):
+        p = _params(rng)
+        h = jax.tree.map(
+            lambda x: x.astype(jnp.float16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+        out = fp16_utils.BN_convert_float(h)
+        assert out["batchnorm_0"]["scale"].dtype == jnp.float32
+        assert out["dense"]["kernel"].dtype == jnp.float16
+
+    def test_master_model_roundtrip(self, rng):
+        p = fp16_utils.network_to_half(_params(rng))
+        model, masters = fp16_utils.prep_param_lists(p)
+        assert masters["dense"]["kernel"].dtype == jnp.float32
+        back = fp16_utils.master_params_to_model_params(model, masters)
+        assert back["dense"]["kernel"].dtype == jnp.float16
+        np.testing.assert_allclose(
+            np.asarray(back["dense"]["kernel"], np.float32),
+            np.asarray(model["dense"]["kernel"], np.float32))
+
+
+class TestFP16Optimizer:
+    def test_training_with_dynamic_scale(self, rng):
+        X = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+        w_true = jnp.asarray(rng.normal(size=(8, 1)), jnp.float32)
+        Y = X @ w_true
+        params = {"w": jnp.zeros((8, 1), jnp.float16)}
+        opt = fp16_utils.FP16_Optimizer(
+            optax.sgd(0.1), dynamic_loss_scale=True,
+            dynamic_loss_args={"init_scale": 2.0 ** 8})
+        state = opt.init(params)
+
+        @jax.jit
+        def step(state, params):
+            def loss_fn(p):
+                pred = X.astype(jnp.float16) @ p["w"]
+                loss = jnp.mean(
+                    (pred.astype(jnp.float32) - Y) ** 2)
+                return opt.scale_loss(state, loss), loss
+            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+            new_state, new_params, finite = opt.step(
+                state, params, grads)
+            return new_state, new_params, loss
+
+        losses = []
+        for _ in range(25):
+            state, params, loss = step(state, params)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.1
+        assert params["w"].dtype == jnp.float16
+
+    def test_overflow_skips_step(self, rng):
+        params = {"w": jnp.ones((4,), jnp.float16)}
+        opt = fp16_utils.FP16_Optimizer(optax.sgd(0.1),
+                                        dynamic_loss_scale=True)
+        state = opt.init(params)
+        bad = {"w": jnp.full((4,), jnp.inf, jnp.float16)}
+        new_state, new_params, finite = opt.step(state, params, bad)
+        assert not bool(finite)
+        np.testing.assert_array_equal(
+            np.asarray(new_params["w"], np.float32),
+            np.asarray(params["w"], np.float32))
+        assert float(new_state.loss_scale_state.loss_scale) == \
+            float(state.loss_scale_state.loss_scale) / 2
+
+    def test_state_dict_roundtrip(self, rng):
+        params = {"w": jnp.ones((4,), jnp.float16)}
+        opt = fp16_utils.FP16_Optimizer(optax.sgd(0.1),
+                                        static_loss_scale=128.0)
+        state = opt.init(params)
+        d = opt.state_dict(state)
+        state2 = opt.load_state_dict(d)
+        assert float(state2.loss_scale_state.loss_scale) == 128.0
+
+
+class TestFusedMixedPrecisionLamb:
+    def test_params_track_fp32_masters(self, rng):
+        params = {"w": jnp.asarray(rng.normal(size=(16, 4)),
+                                   jnp.bfloat16)}
+        tx = optim.fused_mixed_precision_lamb(1e-2)
+        state = tx.init(params)
+        assert state.master_params["w"].dtype == jnp.float32
+        grads = {"w": jnp.ones((16, 4), jnp.bfloat16)}
+        p = params
+        for _ in range(3):
+            updates, state = tx.update(grads, state, p)
+            p = optax.apply_updates(p, updates)
+        assert p["w"].dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(p["w"], np.float32),
+            np.asarray(state.master_params["w"].astype(jnp.bfloat16),
+                       np.float32))
+        # masters actually moved
+        assert not np.allclose(np.asarray(state.master_params["w"]),
+                               np.asarray(params["w"], np.float32))
+
+    def test_matches_plain_lamb_in_fp32(self, rng):
+        w0 = jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
+        grads = {"w": jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)}
+        tx_ref = optim.fused_lamb(1e-2)
+        tx_mp = optim.fused_mixed_precision_lamb(1e-2)
+        p_ref, s_ref = {"w": w0}, tx_ref.init({"w": w0})
+        p_mp, s_mp = {"w": w0}, tx_mp.init({"w": w0})
+        for _ in range(3):
+            u, s_ref = tx_ref.update(grads, s_ref, p_ref)
+            p_ref = optax.apply_updates(p_ref, u)
+            u, s_mp = tx_mp.update(grads, s_mp, p_mp)
+            p_mp = optax.apply_updates(p_mp, u)
+        np.testing.assert_allclose(np.asarray(p_mp["w"]),
+                                   np.asarray(p_ref["w"]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+class TestFlatten:
+    def test_roundtrip(self, rng):
+        tree = {"a": jnp.asarray(rng.normal(size=(3, 4)), jnp.float32),
+                "b": [jnp.arange(5, dtype=jnp.float32)]}
+        flat, unravel = utils.flatten(tree)
+        assert flat.ndim == 1 and flat.size == 17
+        back = unravel(flat * 2.0)
+        np.testing.assert_allclose(np.asarray(back["a"]),
+                                   2 * np.asarray(tree["a"]))
+        back2 = utils.unflatten(flat, tree)
+        np.testing.assert_allclose(np.asarray(back2["b"][0]),
+                                   np.asarray(tree["b"][0]))
