@@ -6,7 +6,11 @@ groups; collectives via GSPMD sharding or explicit shard_map mappings.
 (Pipeline-parallel schedules land in ``pipeline_parallel``.)
 """
 
+from apex_tpu.transformer import data
+from apex_tpu.transformer import log_util
+from apex_tpu.transformer import microbatches
 from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import pipeline_parallel
 from apex_tpu.transformer import mappings
 from apex_tpu.transformer import random
 from apex_tpu.transformer.layers import (
@@ -18,6 +22,11 @@ from apex_tpu.transformer.layers import (
     vocab_parallel_embedding,
 )
 from apex_tpu.transformer.cross_entropy import vocab_parallel_cross_entropy
+from apex_tpu.transformer.data import broadcast_data
+from apex_tpu.transformer.microbatches import (
+    setup_microbatch_calculator,
+    get_num_microbatches,
+)
 from apex_tpu.transformer.utils import (
     divide,
     ensure_divisibility,
@@ -31,7 +40,9 @@ from apex_tpu.transformer.enums import (
 )
 
 __all__ = [
-    "parallel_state", "mappings", "random",
+    "parallel_state", "mappings", "random", "data", "log_util",
+    "microbatches", "pipeline_parallel", "broadcast_data",
+    "setup_microbatch_calculator", "get_num_microbatches",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "column_parallel_linear", "row_parallel_linear",
     "vocab_parallel_embedding",

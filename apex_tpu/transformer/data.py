@@ -1,0 +1,60 @@
+"""Tokenized-batch distribution within the model-parallel group.
+
+Reference: ``apex/transformer/tensor_parallel/data.py`` —
+``broadcast_data(keys, data, datatype)``: rank 0 of each tensor-parallel
+group packs the batch dict into one flat int64 buffer and NCCL-broadcasts
+it so every TP rank sees identical data.
+
+TPU design: under GSPMD there is nothing to broadcast — a batch placed
+with a sharding that does NOT mention the ``tensor``/``pipe`` axes is by
+definition replicated across them, and the runtime moves bytes at most
+once per device.  ``broadcast_data`` therefore (a) validates the batch
+like the reference (same keys, int dtype) and (b) applies the
+replicated-over-model-axes sharding; inside a traced region it reduces
+to ``with_sharding_constraint``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.core.mesh import DATA_AXIS, get_mesh
+
+__all__ = ["broadcast_data", "model_replicated_sharding"]
+
+
+def model_replicated_sharding(mesh=None, *, batch_axes=(DATA_AXIS,)):
+    """Sharding for a batch: split over data axes, replicated over
+    tensor/pipe/context (the TP-group "broadcast" as a layout fact)."""
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P(tuple(batch_axes)))
+
+
+def broadcast_data(keys: Sequence[str], data: Dict[str, Any], datatype,
+                   *, mesh=None) -> Dict[str, jnp.ndarray]:
+    """Validate + place a batch dict replicated across model-parallel axes.
+
+    Parity with the reference's contract: every key in ``keys`` must be
+    present with dtype ``datatype``; returns arrays the whole TP group
+    observes identically.  Outside jit this is a ``device_put``; inside,
+    a sharding constraint.
+    """
+    out = {}
+    sharding = model_replicated_sharding(mesh)
+    for k in keys:
+        if k not in data:
+            raise KeyError(f"broadcast_data: missing key {k!r}")
+        arr = jnp.asarray(data[k])
+        if arr.dtype != jnp.dtype(datatype):
+            raise TypeError(
+                f"broadcast_data: key {k!r} has dtype {arr.dtype}, "
+                f"expected {jnp.dtype(datatype)}")
+        if isinstance(arr, jax.core.Tracer):
+            out[k] = jax.lax.with_sharding_constraint(arr, sharding)
+        else:
+            out[k] = jax.device_put(arr, sharding)
+    return out

@@ -1,0 +1,22 @@
+"""apex_tpu.transformer.testing — shared distributed-test harness.
+
+Reference: ``apex/transformer/testing/{commons,standalone_gpt,
+standalone_bert}.py`` — the toy models + process-group bring-up the
+reference's TP/PP test suite shares (SURVEY.md §2.6, §4).
+"""
+
+from apex_tpu.transformer.testing.commons import (
+    set_random_seed,
+    initialize_distributed,
+    standalone_gpt,
+    standalone_bert,
+    random_token_batch,
+)
+
+__all__ = [
+    "set_random_seed",
+    "initialize_distributed",
+    "standalone_gpt",
+    "standalone_bert",
+    "random_token_batch",
+]

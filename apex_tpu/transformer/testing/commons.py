@@ -1,0 +1,74 @@
+"""Test-harness commons (``apex/transformer/testing/commons.py`` parity).
+
+The reference's ``initialize_distributed`` spins up torch.distributed +
+NCCL groups; here the analogue is building the named mesh (on virtual
+CPU devices in CI).  ``standalone_gpt``/``standalone_bert`` return the
+tiny models + initialized params the schedule/TP tests train.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.core import mesh as mesh_lib
+from apex_tpu.models.bert import BertConfig, BertModel
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+
+__all__ = ["set_random_seed", "initialize_distributed",
+           "standalone_gpt", "standalone_bert", "random_token_batch"]
+
+
+def set_random_seed(seed: int) -> jax.Array:
+    """Seed numpy + return a JAX PRNG key.
+
+    Parity: the reference seeds python/numpy/torch/CUDA and the
+    model-parallel RNG tracker; JAX's functional keys replace the
+    tracker (fold per mesh coordinate where needed —
+    ``apex_tpu.transformer.random``).
+    """
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def initialize_distributed(tensor_model_parallel_size: int = 1,
+                           pipeline_model_parallel_size: int = 1,
+                           **kw):
+    """Build the test mesh (``initialize_distributed`` +
+    ``initialize_model_parallel`` rolled into one — topology is
+    declarative on TPU)."""
+    return mesh_lib.initialize_mesh(
+        tensor_model_parallel_size=tensor_model_parallel_size,
+        pipeline_model_parallel_size=pipeline_model_parallel_size,
+        **kw)
+
+
+def standalone_gpt(seed: int = 0, **cfg_kw) -> Tuple[GPTModel, dict]:
+    """Tiny GPT + params (``standalone_gpt.py`` parity)."""
+    cfg = GPTConfig.tiny(**cfg_kw)
+    model = GPTModel(cfg)
+    key = set_random_seed(seed)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(key, tokens)["params"]
+    return model, params
+
+
+def standalone_bert(seed: int = 0, **cfg_kw) -> Tuple[BertModel, dict]:
+    """Tiny BERT + params (``standalone_bert.py`` parity)."""
+    cfg = BertConfig.tiny(**cfg_kw)
+    model = BertModel(cfg)
+    key = set_random_seed(seed)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(key, tokens)["params"]
+    return model, params
+
+
+def random_token_batch(key: jax.Array, batch: int, seq: int,
+                       vocab: int,
+                       dtype=jnp.int32) -> Tuple[jax.Array, jax.Array]:
+    """(input_ids, labels) for LM tests: labels = inputs shifted left."""
+    ids = jax.random.randint(key, (batch, seq + 1), 0, vocab, dtype)
+    return ids[:, :-1], ids[:, 1:]

@@ -1,0 +1,58 @@
+"""broadcast_data / log_util / testing-commons coverage
+(reference: ``tests/L0/run_transformer`` data & utils tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.core import mesh as mesh_lib
+from apex_tpu.transformer import broadcast_data, log_util
+from apex_tpu.transformer import testing as ttest
+
+
+@pytest.fixture
+def tp_mesh():
+    m = mesh_lib.initialize_mesh(tensor_model_parallel_size=4,
+                                 data_parallel_size=2)
+    yield m
+    mesh_lib.destroy_mesh()
+
+
+class TestBroadcastData:
+    def test_places_replicated_over_model_axes(self, tp_mesh):
+        batch = {"text": np.arange(16, dtype=np.int32).reshape(2, 8),
+                 "types": np.zeros((2, 8), np.int32)}
+        out = broadcast_data(["text", "types"], batch, jnp.int32)
+        spec = out["text"].sharding.spec
+        assert "tensor" not in jax.tree.leaves(spec)
+        np.testing.assert_array_equal(np.asarray(out["text"]),
+                                      batch["text"])
+
+    def test_validates_keys_and_dtype(self, tp_mesh):
+        with pytest.raises(KeyError):
+            broadcast_data(["missing"], {}, jnp.int32)
+        with pytest.raises(TypeError):
+            broadcast_data(["x"], {"x": np.zeros(2, np.float32)},
+                           jnp.int32)
+
+
+class TestLogUtil:
+    def test_logger_namespacing(self):
+        lg = log_util.get_transformer_logger("schedules")
+        assert lg.name == "apex_tpu.transformer.schedules"
+        log_util.set_logging_level("WARNING")
+
+
+class TestCommons:
+    def test_standalone_models_forward(self):
+        model, params = ttest.standalone_gpt()
+        ids, labels = ttest.random_token_batch(
+            jax.random.PRNGKey(1), 2, 16, model.cfg.vocab_size)
+        logits = model.apply({"params": params}, ids)
+        assert logits.shape == (2, 16, model.cfg.vocab_size)
+
+        bmodel, bparams = ttest.standalone_bert()
+        out = bmodel.apply({"params": bparams},
+                           jnp.zeros((2, 8), jnp.int32))
+        assert jax.tree.leaves(out)[0].shape[0] == 2
