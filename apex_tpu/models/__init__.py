@@ -15,6 +15,8 @@ from apex_tpu.models.transformer import (
 )
 from apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
 from apex_tpu.models.bert import BertConfig, BertModel, bert_mlm_loss_fn
+from apex_tpu.models.resnet import ResNetConfig, ResNet, resnet50, resnet18
+from apex_tpu.models.vit import ViTConfig, ViTModel
 
 __all__ = [
     "TransformerConfig",
@@ -28,4 +30,6 @@ __all__ = [
     "BertConfig",
     "BertModel",
     "bert_mlm_loss_fn",
+    "ResNetConfig", "ResNet", "resnet50", "resnet18",
+    "ViTConfig", "ViTModel",
 ]

@@ -1,0 +1,109 @@
+"""ResNet (NHWC) — the reference's canonical amp example workload.
+
+Reference: ``examples/imagenet/main_amp.py`` trains torchvision
+ResNet-50 under amp O1/O2 with apex DDP / SyncBatchNorm
+(BASELINE.json configs[0], configs[2]).
+
+TPU design: channels-last convs (native TPU layout), BN as
+:class:`apex_tpu.parallel.SyncBatchNorm` (cross-replica Welford via
+``psum`` when a data axis is bound, plain BN otherwise), the
+conv+BN+ReLU chains and residual epilogues fused by XLA into the conv
+calls — the same fusions ``apex/contrib/bottleneck`` hand-builds with
+cudnn-frontend graphs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+__all__ = ["ResNetConfig", "ResNet", "resnet50", "resnet18"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    width: int = 64
+    # None → local BN; ("data",) → SyncBN over the data axis
+    bn_axis_names: Optional[Sequence[str]] = None
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+
+class _BN(nn.Module):
+    cfg: ResNetConfig
+    train: bool
+
+    @nn.compact
+    def __call__(self, x):
+        return SyncBatchNorm(
+            use_running_average=not self.train,
+            axis_names=self.cfg.bn_axis_names,
+            param_dtype=self.cfg.param_dtype,
+        )(x)
+
+
+class _BottleneckBlock(nn.Module):
+    cfg: ResNetConfig
+    features: int
+    stride: int = 1
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        conv = lambda f, k, s, name: nn.Conv(
+            f, (k, k), (s, s), padding="SAME" if k > 1 else "VALID",
+            use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name=name)
+        r = conv(self.features, 1, 1, "conv1")(x)
+        r = nn.relu(_BN(cfg, self.train, name="bn1")(r))
+        r = conv(self.features, 3, self.stride, "conv2")(r)
+        r = nn.relu(_BN(cfg, self.train, name="bn2")(r))
+        r = conv(self.features * 4, 1, 1, "conv3")(r)
+        r = _BN(cfg, self.train, name="bn3")(r)
+        if self.stride != 1 or x.shape[-1] != self.features * 4:
+            x = conv(self.features * 4, 1, self.stride, "downsample")(x)
+            x = _BN(cfg, self.train, name="bn_down")(x)
+        return nn.relu(r + x)
+
+
+class ResNet(nn.Module):
+    """Bottleneck ResNet, NHWC input ``(N, H, W, 3)`` → logits."""
+
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        cfg = self.cfg
+        x = nn.Conv(cfg.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype, name="stem")(x)
+        x = nn.relu(_BN(cfg, train, name="bn_stem")(x))
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                x = _BottleneckBlock(
+                    cfg, cfg.width * (2 ** i),
+                    stride=2 if (j == 0 and i > 0) else 1,
+                    train=train, name=f"stage{i}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                     param_dtype=cfg.param_dtype, name="fc")(x)
+        return x
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(ResNetConfig(stage_sizes=(3, 4, 6, 3), **kw))
+
+
+def resnet18(**kw) -> ResNet:
+    """Small variant for tests (still bottleneck blocks — depth 2/2/2/2)."""
+    return ResNet(ResNetConfig(stage_sizes=(2, 2, 2, 2), **kw))

@@ -1,0 +1,91 @@
+"""ViT — large-batch FusedLAMB workload (BASELINE.json configs[4]).
+
+Reference: no ViT model ships in apex; BASELINE.json names "ViT-Huge
+large-batch FusedLAMB + fused attention" as a workload config, with
+apex supplying the pieces (FusedLAMB, fused MHA, FusedLayerNorm).  This
+module is the assembled TPU-native workload: patch-embed conv + the
+parallel transformer core (Pallas attention/LN, TP/SP via GSPMD).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.models.transformer import (
+    ParallelTransformer,
+    TransformerConfig,
+    _norm,
+)
+
+__all__ = ["ViTConfig", "ViTModel"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig(TransformerConfig):
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        kw.setdefault("hidden_size", 128)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 2)
+        kw.setdefault("image_size", 32)
+        kw.setdefault("patch_size", 8)
+        kw.setdefault("num_classes", 10)
+        return cls(**kw)
+
+    @classmethod
+    def vit_huge(cls, **kw) -> "ViTConfig":
+        """ViT-H/14 (the large-batch LAMB benchmark sizing)."""
+        kw.setdefault("hidden_size", 1280)
+        kw.setdefault("num_layers", 32)
+        kw.setdefault("num_heads", 16)
+        kw.setdefault("patch_size", 14)
+        return cls(**kw)
+
+    def __post_init__(self):
+        super().__post_init__()
+        # encoder: bidirectional attention, learned positions
+        object.__setattr__(self, "causal", False)
+        object.__setattr__(self, "position_embedding", "learned")
+        seq = (self.image_size // self.patch_size) ** 2 + 1
+        object.__setattr__(self, "max_seq_len", seq)
+
+
+class ViTModel(nn.Module):
+    """ViT classifier: NHWC image → (N, num_classes) logits."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.cfg
+        p = cfg.patch_size
+        x = nn.Conv(cfg.hidden_size, (p, p), (p, p), padding="VALID",
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    name="patch_embed")(x)
+        n, h, w, c = x.shape
+        x = x.reshape(n, h * w, c)
+        cls_tok = self.param("cls_token", nn.initializers.zeros_init(),
+                             (1, 1, cfg.hidden_size), cfg.param_dtype)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls_tok.astype(x.dtype), (n, 1, c)), x],
+            axis=1)
+        pos = self.param("position_embedding",
+                         nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.hidden_size),
+                         cfg.param_dtype)
+        x = x + pos[None, : x.shape[1]].astype(x.dtype)
+        x = ParallelTransformer(cfg, name="transformer")(
+            x, deterministic=deterministic)
+        x = _norm(cfg, "final_norm")(x)
+        logits = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                          param_dtype=cfg.param_dtype, name="head")(
+            x[:, 0].astype(jnp.float32))
+        return logits
