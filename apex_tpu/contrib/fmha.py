@@ -31,13 +31,20 @@ def fmha(qkv, cu_seqlens=None, *, causal: bool = False, max_s=None,
     """
     q, k, v = (qkv[:, :, i] for i in range(3))
     bias = None
+    pad = None
     if cu_seqlens is not None:
         lens = cu_seqlens[1:] - cu_seqlens[:-1]          # (B,)
         pos = jnp.arange(q.shape[1])
         pad = pos[None, :] >= lens[:, None]              # (B, S) True=pad
         bias = mask_to_bias(pad)[:, None, None, :]       # (B,1,1,Sk)
-    return fused_attention(q, k, v, causal=causal, bias=bias,
-                           implementation=implementation)
+    out = fused_attention(q, k, v, causal=causal, bias=bias,
+                          implementation=implementation)
+    if pad is not None:
+        # pad query rows are artifacts of the padded layout (the
+        # reference's packed layout has no such rows) — zero them so
+        # downstream reductions over (B, S) see no garbage.
+        out = jnp.where(pad[:, :, None, None], 0.0, out)
+    return out
 
 
 class FMHAFun:

@@ -99,8 +99,17 @@ class GroupBatchNorm2d(nn.Module):
                 reduce_dims=tuple(range(x.ndim - 1)))
             if not self.is_initializing():
                 m = self.momentum
-                ra_mean.value = m * ra_mean.value + (1 - m) * mean
-                ra_var.value = m * ra_var.value + (1 - m) * var
+                # normalization uses per-group stats, but the running
+                # buffers are a single logically-replicated variable —
+                # average the group stats over the whole axis so every
+                # replica stores the same (global-batch) running stats
+                # instead of one arbitrary group's.
+                rmean, rvar = mean, var
+                if axis is not None and self.bn_group > 1:
+                    rmean = lax.pmean(mean, axis)
+                    rvar = lax.pmean(var, axis)
+                ra_mean.value = m * ra_mean.value + (1 - m) * rmean
+                ra_var.value = m * ra_var.value + (1 - m) * rvar
 
         y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
         y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)

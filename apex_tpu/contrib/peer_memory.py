@@ -39,23 +39,24 @@ def halo_exchange(x, *, axis_name: str, halo: int, spatial_dim: int = 1,
     halo rows between adjacent ranks).
     """
     n = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
 
     top = lax.slice_in_dim(x, 0, halo, axis=spatial_dim)
     bot = lax.slice_in_dim(x, x.shape[spatial_dim] - halo,
                            x.shape[spatial_dim], axis=spatial_dim)
 
     # Send my bottom rows down (they become the lower neighbor's top
-    # halo) and my top rows up.
-    perm_down = [(i, (i + 1) % n) for i in range(n)]
-    perm_up = [(i, (i - 1) % n) for i in range(n)]
+    # halo) and my top rows up.  Without wrap the permutation is simply
+    # truncated — ppermute zero-fills devices that receive nothing, so
+    # the edge shards get the zero padding for free and the wrap link
+    # (the longest ICI hop on a non-torus mesh) carries no traffic.
+    if wrap:
+        perm_down = [(i, (i + 1) % n) for i in range(n)]
+        perm_up = [(i, (i - 1) % n) for i in range(n)]
+    else:
+        perm_down = [(i, i + 1) for i in range(n - 1)]
+        perm_up = [(i, i - 1) for i in range(1, n)]
     from_above = lax.ppermute(bot, axis_name, perm_down)
     from_below = lax.ppermute(top, axis_name, perm_up)
-
-    if not wrap:
-        zero = jnp.zeros_like(top)
-        from_above = jnp.where(idx == 0, zero, from_above)
-        from_below = jnp.where(idx == n - 1, zero, from_below)
 
     return jnp.concatenate([from_above, x, from_below], axis=spatial_dim)
 

@@ -223,10 +223,6 @@ class TestHaloExchange:
     def test_matches_gather(self, ctx_mesh, rng):
         x = jnp.asarray(rng.normal(size=(2, 16, 3)), jnp.float32)
 
-        f = shard_map(
-            lambda xs: peer_memory.halo_exchange(
-                xs, axis_name="context", halo=1, spatial_dim=1),
-            ctx_mesh, (P(None, "context"),), P(None, "context", None))
         # out has local H 2+2*1=4 per shard → global 32; check per shard
         def fm(xs):
             return peer_memory.halo_exchange(
