@@ -41,7 +41,9 @@ from apex_tpu.core.mesh import (
 
 from apex_tpu import amp
 from apex_tpu import core
+from apex_tpu import data
 from apex_tpu import fp16_utils
+from apex_tpu import native
 from apex_tpu import models
 from apex_tpu import ops
 from apex_tpu import optim
@@ -63,7 +65,9 @@ __all__ = [
     "destroy_mesh",
     "amp",
     "core",
+    "data",
     "fp16_utils",
+    "native",
     "ops",
     "optim",
     "parallel",

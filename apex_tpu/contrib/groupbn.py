@@ -107,7 +107,10 @@ class GroupBatchNorm2d(nn.Module):
                 rmean, rvar = mean, var
                 if axis is not None and self.bn_group > 1:
                     rmean = lax.pmean(mean, axis)
-                    rvar = lax.pmean(var, axis)
+                    # law of total variance: E[var] alone drops the
+                    # between-group component E[mean²] - E[mean]²
+                    rvar = (lax.pmean(var + jnp.square(mean), axis)
+                            - jnp.square(rmean))
                 ra_mean.value = m * ra_mean.value + (1 - m) * rmean
                 ra_var.value = m * ra_var.value + (1 - m) * rvar
 

@@ -1,0 +1,14 @@
+"""apex_tpu.data — host-side input pipeline runtime.
+
+Reference: apex ships no data loader (SURVEY.md §0) — its examples lean
+on torch ``DataLoader`` with pinned-memory prefetch and the
+``gpu_direct_storage`` contrib for direct-to-device IO.  The TPU-native
+runtime equivalent is a prefetching device feeder: a background thread
+stages upcoming host batches onto the devices (sharded per the mesh)
+while the current step computes, hiding host→HBM transfer latency the
+way pinned-memory double buffering does on CUDA.
+"""
+
+from apex_tpu.data.prefetch import PrefetchLoader, prefetch_to_device
+
+__all__ = ["PrefetchLoader", "prefetch_to_device"]

@@ -1,0 +1,101 @@
+"""Prefetching device feeder — overlap host→device with compute.
+
+Double-buffering loader: a daemon thread pulls host batches (numpy
+pytrees) from the source iterator, stages them with ``jax.device_put``
+(non-blocking — the transfer overlaps the in-flight computation), and
+hands them over a bounded queue.  ``buffer_size=2`` is classic double
+buffering; the native ``_apex_C`` packer (``apex_tpu.native``) can
+assemble batches upstream of this.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+
+__all__ = ["PrefetchLoader", "prefetch_to_device"]
+
+_DONE = object()
+
+
+class PrefetchLoader:
+    """Iterate device-resident batches, prefetched ``buffer_size`` ahead.
+
+    ``sharding``: optional ``jax.sharding.Sharding`` (or pytree of
+    shardings matching the batch structure) applied in ``device_put`` —
+    e.g. ``NamedSharding(mesh, P("data"))`` to scatter the batch over
+    the data axis while the previous step runs.
+    """
+
+    def __init__(self, source: Iterable[Any], *, sharding=None,
+                 buffer_size: int = 2,
+                 transform: Optional[Callable[[Any], Any]] = None):
+        if buffer_size < 1:
+            raise ValueError("buffer_size must be >= 1")
+        self._source = source
+        self._sharding = sharding
+        self._buffer_size = buffer_size
+        self._transform = transform
+
+    def __iter__(self) -> Iterator[Any]:
+        q: "queue.Queue" = queue.Queue(maxsize=self._buffer_size)
+        stop = threading.Event()
+        err: list = []
+
+        def worker():
+            try:
+                for batch in self._source:
+                    if stop.is_set():
+                        return
+                    if self._transform is not None:
+                        batch = self._transform(batch)
+                    if self._sharding is not None:
+                        batch = jax.device_put(batch, self._sharding)
+                    else:
+                        batch = jax.device_put(batch)
+                    # bounded put that stays responsive to early consumer
+                    # exit — a plain q.put could block forever with the
+                    # thread (and its device batches) leaked.
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except BaseException as e:  # surface in the consumer
+                err.append(e)
+            finally:
+                while not stop.is_set():
+                    try:
+                        q.put(_DONE, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="apex-tpu-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    break
+                yield item
+            if err:
+                raise err[0]
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+            close = getattr(self._source, "close", None)
+            if callable(close):
+                close()
+
+
+def prefetch_to_device(iterator: Iterable[Any], size: int = 2,
+                       sharding=None) -> Iterator[Any]:
+    """Functional form: ``for batch in prefetch_to_device(it, 2): ...``"""
+    return iter(PrefetchLoader(iterator, sharding=sharding,
+                               buffer_size=size))

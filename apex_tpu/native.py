@@ -1,0 +1,57 @@
+"""Gateway to the native ``_apex_C`` extension (with numpy fallback).
+
+Reference: ``csrc/flatten_unflatten.cpp`` loaded as the ``apex_C``
+module.  The import-try pattern mirrors the reference's contrib
+extensions ("was this extension built?" — SURVEY.md §4): everything
+works without the native build, just slower on large host buffers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+try:  # built by setup.py; optional
+    import _apex_C  # type: ignore
+
+    HAVE_NATIVE = True
+except ImportError:  # pure-python install
+    _apex_C = None
+    HAVE_NATIVE = False
+
+__all__ = ["HAVE_NATIVE", "flatten_host_buffers", "unflatten_host_buffer"]
+
+
+def flatten_host_buffers(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Pack host arrays into one byte buffer (``apex_C.flatten``).
+
+    Used for host-side staging (checkpoint assembly, batch packing);
+    device-side flattening is XLA's job (see ``apex_tpu.utils.flatten``).
+    """
+    arrs = [np.ascontiguousarray(a) for a in arrays]
+    if HAVE_NATIVE:
+        # frombuffer wraps the returned bytearray zero-copy
+        return np.frombuffer(_apex_C.flatten(arrs), np.uint8)
+    if not arrs:
+        return np.empty((0,), np.uint8)
+    return np.concatenate([a.view(np.uint8).reshape(-1) for a in arrs])
+
+
+def unflatten_host_buffer(flat: np.ndarray,
+                          like: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Split a flat byte buffer back into arrays shaped like ``like``
+    (``apex_C.unflatten``)."""
+    sizes = [a.nbytes for a in like]
+    if HAVE_NATIVE:
+        chunks = _apex_C.unflatten(np.ascontiguousarray(flat), sizes)
+        return [np.frombuffer(c, a.dtype).reshape(a.shape)
+                for c, a in zip(chunks, like)]
+    if sum(sizes) != flat.nbytes:
+        raise ValueError("unflatten: sizes do not sum to buffer length")
+    out, off = [], 0
+    view = flat.view(np.uint8).reshape(-1)
+    for a in like:
+        out.append(view[off:off + a.nbytes].view(a.dtype).reshape(a.shape))
+        off += a.nbytes
+    return out

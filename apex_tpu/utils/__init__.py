@@ -10,6 +10,14 @@ from apex_tpu.utils.tree import (
     global_grad_clip_coef,
 )
 from apex_tpu.utils.flatten import flatten, unflatten
+from apex_tpu.utils.checkpoint import (
+    save_checkpoint, restore_checkpoint, checkpoint_manager,
+)
+from apex_tpu.utils import profiler
+from apex_tpu.utils.debug import (
+    enable_nan_checks, nan_check_mode, checkify_finite, tree_health,
+)
+from apex_tpu.utils.metrics import MetricsWriter, log_metrics
 
 __all__ = [
     "is_floating",
@@ -21,4 +29,9 @@ __all__ = [
     "global_grad_clip_coef",
     "flatten",
     "unflatten",
+    "save_checkpoint", "restore_checkpoint", "checkpoint_manager",
+    "profiler",
+    "enable_nan_checks", "nan_check_mode", "checkify_finite",
+    "tree_health",
+    "MetricsWriter", "log_metrics",
 ]

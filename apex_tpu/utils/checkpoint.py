@@ -1,0 +1,71 @@
+"""Checkpoint save/resume for train-state pytrees.
+
+Reference: the canonical pattern of ``examples/imagenet/main_amp.py`` —
+save model + optimizer + ``amp.state_dict()`` (loss-scaler state)
+together — plus ``DistributedFusedAdam``'s sharded-state save/load
+(SURVEY.md §5 checkpoint row).
+
+TPU design: orbax — async, sharded-aware (each host writes its shards;
+on restore, arrays come back with the shardings of the abstract
+target).  The loss-scale state lives *inside* the train-state pytree
+(``MixedPrecisionTrainState``), so one ``save`` captures everything the
+reference persists in three separate dicts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "checkpoint_manager"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
+    """Write ``state`` (any pytree: train state, params, …) to ``path``.
+
+    Blocks until the write completes (orbax's async machinery still
+    overlaps the device→host copies).
+    """
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(path), state, force=force)
+    ckptr.wait_until_finished()
+
+
+def restore_checkpoint(path: str, target: Any) -> Any:
+    """Restore a pytree saved by :func:`save_checkpoint`.
+
+    ``target`` supplies structure/shapes/dtypes/shardings — pass the
+    freshly-initialized state (or ``jax.eval_shape`` of it) and arrays
+    are restored directly into the right placement.
+    """
+    import orbax.checkpoint as ocp
+
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+    return _checkpointer().restore(os.path.abspath(path), abstract)
+
+
+def checkpoint_manager(directory: str, *, max_to_keep: int = 3,
+                       save_interval_steps: int = 1):
+    """Rolling-checkpoint manager (orbax ``CheckpointManager``).
+
+    Usage::
+
+        mngr = checkpoint_manager("ckpts", max_to_keep=3)
+        mngr.save(step, args=ocp.args.StandardSave(state))
+        state = mngr.restore(mngr.latest_step(),
+                             args=ocp.args.StandardRestore(abstract))
+    """
+    import orbax.checkpoint as ocp
+
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=max_to_keep,
+        save_interval_steps=save_interval_steps)
+    return ocp.CheckpointManager(os.path.abspath(directory),
+                                 options=options)

@@ -1,0 +1,82 @@
+"""Debug mode — NaN checking and numeric tripwires.
+
+Reference: none in-tree (the reference relies on out-of-band
+``compute-sanitizer`` runs — SURVEY.md §5 race-detection row).  The TPU
+rebuild ships the checks: global debug-NaN mode, an in-graph finite
+assertion usable under jit, and a pytree health report for post-mortems.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.tree import is_floating
+
+__all__ = ["enable_nan_checks", "nan_check_mode", "checkify_finite",
+           "tree_health"]
+
+
+def enable_nan_checks(enable: bool = True) -> None:
+    """Globally re-run jitted computations eagerly on NaN output
+    (``jax.config.debug_nans``) — the heavy hammer for localizing the
+    op that produced the first NaN."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+@contextlib.contextmanager
+def nan_check_mode() -> Iterator[None]:
+    """Scoped :func:`enable_nan_checks`."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def checkify_finite(tree: Any, name: str = "tree"):
+    """In-graph assertion that every floating leaf is finite.
+
+    Uses ``checkify.check`` — the enclosing jitted function must be
+    wrapped with ``jax.experimental.checkify.checkify`` to functionalize
+    the check (a bare ``jax.jit`` raises at trace time).  Returns
+    ``tree`` unchanged so it can be inserted inline::
+
+        grads = checkify_finite(grads, "grads")
+        ...
+        err, out = checkify.checkify(jax.jit(step))(state, batch)
+        err.throw()
+    """
+    from jax.experimental import checkify
+
+    flat = [l for l in jax.tree.leaves(tree) if is_floating(l)]
+    ok = jnp.array(True)
+    for l in flat:
+        ok = ok & jnp.all(jnp.isfinite(l))
+    checkify.check(ok, f"non-finite values in {name}")
+    return tree
+
+
+def tree_health(tree: Any) -> dict:
+    """Host-side post-mortem: per-leaf count of nan/inf + norms."""
+    report = {}
+
+    def one(path, leaf):
+        if not is_floating(leaf):
+            return
+        arr = jax.device_get(leaf)
+        key = "/".join(str(getattr(p, "key", p)) for p in path)
+        import numpy as np
+        report[key] = {
+            "shape": tuple(arr.shape),
+            "nan": int(np.isnan(arr).sum()),
+            "inf": int(np.isinf(arr).sum()),
+            "max_abs": float(np.max(np.abs(arr))) if arr.size else 0.0,
+        }
+
+    jax.tree_util.tree_map_with_path(one, tree)
+    return report

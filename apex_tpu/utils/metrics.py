@@ -1,0 +1,45 @@
+"""Lightweight training metrics — dict in, host writer out.
+
+Reference: no metrics subsystem (``print``/``logging`` in examples —
+SURVEY.md §5).  Kept deliberately thin: a device-side metrics dict that
+can be emitted from inside jit via ``jax.debug.callback``, draining to
+any writer (default: the package logger).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+__all__ = ["MetricsWriter", "log_metrics"]
+
+_logger = logging.getLogger("apex_tpu.metrics")
+
+
+class MetricsWriter:
+    """Collects scalar metrics; pluggable sink (logger, file, list)."""
+
+    def __init__(self, sink: Optional[Callable[[int, Dict[str, float]], None]] = None):
+        self.history: list = []
+        self._sink = sink
+
+    def __call__(self, step: int, metrics: Dict[str, Any]) -> None:
+        row = {k: float(v) for k, v in metrics.items()}
+        self.history.append((int(step), row))
+        if self._sink is not None:
+            self._sink(int(step), row)
+        else:
+            _logger.info("step %d %s", int(step),
+                         " ".join(f"{k}={v:.6g}" for k, v in row.items()))
+
+
+def log_metrics(writer: MetricsWriter, step, metrics: Dict[str, Any]) -> None:
+    """Emit metrics from inside a jitted computation.
+
+    ``jax.debug.callback`` ships the (tiny) scalars to the host without
+    blocking the device — the TPU-friendly version of the reference
+    examples' per-step prints.
+    """
+    jax.debug.callback(writer, step, metrics)

@@ -1,0 +1,50 @@
+"""Profiling / tracing integration.
+
+Reference: apex has no first-class profiling subsystem (``apex.pyprof``
+was removed; what remains is scattered ``torch.cuda.nvtx`` ranges —
+SURVEY.md §5).  The TPU rebuild does strictly better by wiring
+``jax.profiler``: traces land in TensorBoard with per-op XLA timelines,
+and ``annotate`` gives the nvtx-style named ranges.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+__all__ = ["trace", "annotate", "start_server", "save_device_memory_profile"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Capture a profiler trace of the enclosed block into ``log_dir``
+    (view with TensorBoard's profile plugin)."""
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named range visible in profiler timelines (nvtx.range parity).
+
+    Use as context manager or decorator::
+
+        with annotate("fused_adam_step"):
+            state = step(state, batch)
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+def start_server(port: int = 9999):
+    """Start the on-demand profiling server (TensorBoard 'capture')."""
+    return jax.profiler.start_server(port)
+
+
+def save_device_memory_profile(path: str) -> None:
+    """Dump the current device memory profile (pprof format)."""
+    jax.profiler.save_device_memory_profile(path)

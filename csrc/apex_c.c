@@ -1,0 +1,150 @@
+/* _apex_C — native flatten/unflatten for host-side buffer staging.
+ *
+ * TPU-native equivalent of the reference's csrc/flatten_unflatten.cpp
+ * (apex_C.flatten / apex_C.unflatten): pack a list of contiguous
+ * buffers into one flat allocation and split it back.  On GPU the
+ * reference uses this to build DDP gradient buckets; on TPU the XLA
+ * compiler owns device-side layout, so the native fast path that
+ * remains is HOST-side staging — checkpoint assembly, tokenized-batch
+ * packing, IO — where memcpy bandwidth matters and the GIL can be
+ * dropped.
+ *
+ * Pure CPython C API (no pybind11 in the image); objects are anything
+ * supporting the buffer protocol (numpy arrays, memoryviews, bytes).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+/* flatten(seq_of_buffers) -> bytearray
+ * Concatenate raw bytes of each C-contiguous buffer; GIL released
+ * during the copies. */
+static PyObject *
+apex_c_flatten(PyObject *self, PyObject *arg)
+{
+    PyObject *seq = PySequence_Fast(arg, "flatten expects a sequence");
+    if (seq == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+    Py_buffer *views = PyMem_Calloc((size_t)(n > 0 ? n : 1),
+                                    sizeof(Py_buffer));
+    if (views == NULL) {
+        Py_DECREF(seq);
+        return PyErr_NoMemory();
+    }
+
+    Py_ssize_t total = 0;
+    Py_ssize_t i;
+    for (i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+        if (PyObject_GetBuffer(item, &views[i],
+                               PyBUF_C_CONTIGUOUS | PyBUF_SIMPLE) < 0)
+            goto fail;
+        total += views[i].len;
+    }
+
+    PyObject *out = PyByteArray_FromStringAndSize(NULL, total);
+    if (out == NULL)
+        goto fail;
+    char *dst = PyByteArray_AS_STRING(out);
+
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t j = 0; j < n; j++) {
+        memcpy(dst, views[j].buf, (size_t)views[j].len);
+        dst += views[j].len;
+    }
+    Py_END_ALLOW_THREADS
+
+    for (Py_ssize_t j = 0; j < n; j++)
+        PyBuffer_Release(&views[j]);
+    PyMem_Free(views);
+    Py_DECREF(seq);
+    return out;
+
+fail:
+    for (Py_ssize_t j = 0; j < i; j++)
+        PyBuffer_Release(&views[j]);
+    PyMem_Free(views);
+    Py_DECREF(seq);
+    return NULL;
+}
+
+/* unflatten(flat, sizes) -> list of bytearray
+ * Split `flat` (buffer) into chunks of the given byte sizes. */
+static PyObject *
+apex_c_unflatten(PyObject *self, PyObject *args)
+{
+    PyObject *flat_obj, *sizes_obj;
+    if (!PyArg_ParseTuple(args, "OO", &flat_obj, &sizes_obj))
+        return NULL;
+
+    Py_buffer flat;
+    if (PyObject_GetBuffer(flat_obj, &flat,
+                           PyBUF_C_CONTIGUOUS | PyBUF_SIMPLE) < 0)
+        return NULL;
+
+    PyObject *sizes = PySequence_Fast(sizes_obj,
+                                      "unflatten expects a size sequence");
+    if (sizes == NULL) {
+        PyBuffer_Release(&flat);
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(sizes);
+    PyObject *out = PyList_New(n);
+    if (out == NULL)
+        goto fail;
+
+    Py_ssize_t off = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        Py_ssize_t sz = PyNumber_AsSsize_t(
+            PySequence_Fast_GET_ITEM(sizes, i), PyExc_OverflowError);
+        if (sz < 0 && PyErr_Occurred())
+            goto fail_list;
+        if (off + sz > flat.len) {
+            PyErr_SetString(PyExc_ValueError,
+                            "unflatten: sizes exceed buffer length");
+            goto fail_list;
+        }
+        PyObject *chunk = PyByteArray_FromStringAndSize(
+            (const char *)flat.buf + off, sz);
+        if (chunk == NULL)
+            goto fail_list;
+        PyList_SET_ITEM(out, i, chunk);
+        off += sz;
+    }
+    if (off != flat.len) {
+        PyErr_SetString(PyExc_ValueError,
+                        "unflatten: sizes do not sum to buffer length");
+        goto fail_list;
+    }
+    Py_DECREF(sizes);
+    PyBuffer_Release(&flat);
+    return out;
+
+fail_list:
+    Py_DECREF(out);
+fail:
+    Py_DECREF(sizes);
+    PyBuffer_Release(&flat);
+    return NULL;
+}
+
+static PyMethodDef ApexCMethods[] = {
+    {"flatten", apex_c_flatten, METH_O,
+     "flatten(buffers) -> bytearray: concatenate contiguous buffers"},
+    {"unflatten", apex_c_unflatten, METH_VARARGS,
+     "unflatten(flat, sizes) -> list[bytearray]: split a flat buffer"},
+    {NULL, NULL, 0, NULL}
+};
+
+static struct PyModuleDef apex_c_module = {
+    PyModuleDef_HEAD_INIT, "_apex_C",
+    "native host-side buffer packing (apex_C parity)", -1, ApexCMethods
+};
+
+PyMODINIT_FUNC
+PyInit__apex_C(void)
+{
+    return PyModule_Create(&apex_c_module);
+}

@@ -1,0 +1,37 @@
+"""Build shim: optional native extension on top of pyproject.toml.
+
+Reference: apex's ``setup.py`` gates CUDA extensions behind feature
+flags (``--cpp_ext --cuda_ext``, SURVEY.md §2.8).  Here the compute
+kernels are Pallas (no native build); the one native piece is the
+host-side ``_apex_C`` buffer packer, built by default and skipped
+gracefully if no C toolchain exists (the package falls back to numpy —
+``apex_tpu/native.py``).
+"""
+
+from setuptools import Extension, setup
+from setuptools.command.build_ext import build_ext
+
+
+class OptionalBuildExt(build_ext):
+    """Never fail the install because the optional C ext didn't build."""
+
+    def run(self):
+        try:
+            super().run()
+        except Exception as exc:  # toolchain missing: pure-python install
+            print(f"warning: skipping native _apex_C build: {exc}")
+
+    def build_extension(self, ext):
+        try:
+            super().build_extension(ext)
+        except Exception as exc:
+            print(f"warning: skipping native {ext.name} build: {exc}")
+
+
+setup(
+    ext_modules=[
+        Extension("_apex_C", sources=["csrc/apex_c.c"],
+                  extra_compile_args=["-O3"]),
+    ],
+    cmdclass={"build_ext": OptionalBuildExt},
+)

@@ -1,0 +1,97 @@
+"""Aux subsystems: checkpoint save/resume (incl. loss-scale state),
+debug tripwires, metrics writer (SURVEY.md §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp, utils
+
+
+class TestCheckpoint:
+    def test_train_state_roundtrip(self, tmp_path, rng):
+        params = {"w": jnp.asarray(rng.normal(size=(4, 2)), jnp.float32)}
+        state = amp.initialize(lambda p, x: x @ p["w"], params,
+                               optax.adam(1e-3), opt_level="O2",
+                               half_dtype=jnp.float16)
+        # advance so step/scale/opt state are non-trivial
+        x = jnp.ones((3, 4))
+        grads = jax.grad(lambda p: jnp.sum(
+            state.apply_fn(p, x)) * 2.0)(state.compute_params())
+        state, _ = state.apply_gradients(grads=grads)
+
+        saveable = {"params": state.params,
+                    "opt_state": state.opt_state,
+                    "step": state.step,
+                    "amp": state.amp_state_dict()}
+        path = str(tmp_path / "ckpt")
+        utils.save_checkpoint(path, saveable)
+        restored = utils.restore_checkpoint(path, saveable)
+        np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                      np.asarray(state.params["w"]))
+        assert int(restored["step"]) == 1
+        assert float(restored["amp"]["loss_scale"]) == float(
+            state.loss_scale_state.loss_scale)
+        state2 = state.load_amp_state_dict(restored["amp"])
+        assert float(state2.loss_scale_state.loss_scale) == float(
+            state.loss_scale_state.loss_scale)
+
+    def test_manager_rolls(self, tmp_path):
+        import orbax.checkpoint as ocp
+        mngr = utils.checkpoint_manager(str(tmp_path / "m"),
+                                        max_to_keep=2)
+        tree = {"a": jnp.zeros((2,))}
+        for step in range(4):
+            mngr.save(step, args=ocp.args.StandardSave(tree))
+        mngr.wait_until_finished()
+        assert mngr.latest_step() == 3
+        assert len(mngr.all_steps()) <= 2
+
+
+class TestDebug:
+    def test_checkify_finite_raises(self):
+        from jax.experimental import checkify
+
+        def f(x):
+            return utils.checkify_finite({"x": x}, "x")["x"] * 2
+
+        checked = checkify.checkify(jax.jit(f))
+        err, out = checked(jnp.ones((3,)))
+        err.throw()  # no error
+        err, out = checked(jnp.array([1.0, jnp.inf, 0.0]))
+        with pytest.raises(Exception, match="non-finite"):
+            err.throw()
+
+    def test_tree_health(self):
+        rep = utils.tree_health(
+            {"a": jnp.array([1.0, jnp.nan]), "b": jnp.array([jnp.inf]),
+             "i": jnp.array([1, 2])})
+        assert rep["a"]["nan"] == 1
+        assert rep["b"]["inf"] == 1
+        assert "i" not in rep
+
+    def test_nan_check_mode_scoped(self):
+        assert not jax.config.jax_debug_nans
+        with utils.nan_check_mode():
+            assert jax.config.jax_debug_nans
+        assert not jax.config.jax_debug_nans
+
+
+class TestMetrics:
+    def test_writer_from_jit(self):
+        rows = []
+        w = utils.MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+
+        @jax.jit
+        def step(i, x):
+            loss = jnp.sum(x) * i
+            utils.log_metrics(w, i, {"loss": loss})
+            return loss
+
+        for i in range(3):
+            step(i, jnp.ones((2,))).block_until_ready()
+        jax.effects_barrier()
+        assert [s for s, _ in rows] == [0, 1, 2]
+        assert rows[2][1]["loss"] == 4.0

@@ -312,3 +312,26 @@ class TestFMHA:
         np.testing.assert_allclose(np.asarray(out[1]),
                                    np.asarray(want1[0]),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestGroupBNRunningStats:
+    def test_running_var_law_of_total_variance(self, dp8_mesh, rng):
+        # groups with very different means: stored running var must
+        # include the between-group component (≈ global-batch var)
+        base = rng.normal(size=(16, 4)).astype(np.float32)
+        shift = np.repeat(np.arange(8, dtype=np.float32) * 5.0, 2)
+        x = jnp.asarray(base + shift[:, None])
+        gbn = groupbn.GroupBatchNorm2d(
+            bn_group=2, axis_name="data", use_running_average=False,
+            momentum=0.0)
+        v = gbn.init(jax.random.PRNGKey(0), x[:2])
+
+        def fwd(xs):
+            y, mut = gbn.apply(v, xs, mutable=["batch_stats"])
+            return y, mut["batch_stats"]["var"]
+
+        _, rvar = shard_map(fwd, dp8_mesh, (P("data"),),
+                            (P("data"), P()))(x)
+        want = np.asarray(x).var(axis=0)
+        np.testing.assert_allclose(np.asarray(rvar), want,
+                                   rtol=1e-3, atol=1e-3)

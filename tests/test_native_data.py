@@ -1,0 +1,111 @@
+"""Native _apex_C packer + prefetch loader (host runtime pieces).
+
+Native tests are skip-guarded on the built extension, mirroring the
+reference's contrib import-try pattern (SURVEY.md §4)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import native
+from apex_tpu.data import PrefetchLoader, prefetch_to_device
+from apex_tpu.core import mesh as mesh_lib
+
+
+class TestNativeFlatten:
+    def test_fallback_roundtrip(self, rng):
+        arrs = [rng.normal(size=(4, 3)).astype(np.float32),
+                np.arange(7, dtype=np.int64)]
+        # force the numpy path regardless of build
+        flat = np.concatenate([a.view(np.uint8).reshape(-1)
+                               for a in arrs])
+        out = native.unflatten_host_buffer(flat, arrs)
+        for a, b in zip(arrs, out):
+            np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.skipif(not native.HAVE_NATIVE,
+                        reason="_apex_C not built")
+    def test_native_roundtrip(self, rng):
+        arrs = [rng.normal(size=(128, 64)).astype(np.float32),
+                rng.integers(0, 100, size=(33,)).astype(np.int32),
+                np.empty((0,), np.float64)]
+        flat = native.flatten_host_buffers(arrs)
+        assert flat.nbytes == sum(a.nbytes for a in arrs)
+        out = native.unflatten_host_buffer(flat, arrs)
+        for a, b in zip(arrs, out):
+            np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.skipif(not native.HAVE_NATIVE,
+                        reason="_apex_C not built")
+    def test_native_size_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            native.unflatten_host_buffer(
+                np.zeros(10, np.uint8), [np.zeros(3, np.uint8)])
+
+
+class TestPrefetch:
+    def test_order_and_values(self, rng):
+        batches = [{"x": np.full((4,), i, np.float32)} for i in range(5)]
+        out = list(PrefetchLoader(batches, buffer_size=2))
+        assert len(out) == 5
+        for i, b in enumerate(out):
+            assert isinstance(b["x"], jax.Array)
+            np.testing.assert_array_equal(np.asarray(b["x"]), i)
+
+    def test_sharded_prefetch(self, rng):
+        m = mesh_lib.initialize_mesh(data_parallel_size=8)
+        try:
+            sharding = NamedSharding(m, P("data"))
+            batches = [np.ones((16, 2), np.float32) * i
+                       for i in range(3)]
+            out = list(prefetch_to_device(batches, 2, sharding=sharding))
+            assert out[1].sharding.spec == P("data")
+            np.testing.assert_array_equal(np.asarray(out[2]), 2.0)
+        finally:
+            mesh_lib.destroy_mesh()
+
+    def test_transform_and_error_propagation(self):
+        def gen():
+            yield np.ones((2,))
+            raise RuntimeError("source died")
+
+        it = PrefetchLoader(gen(), transform=lambda b: b * 2)
+        got = []
+        with pytest.raises(RuntimeError, match="source died"):
+            for b in it:
+                got.append(np.asarray(b))
+        assert len(got) == 1 and got[0][0] == 2.0
+
+    def test_early_exit_no_thread_leak(self):
+        import threading, time
+        before = {t.name for t in threading.enumerate()}
+        it = iter(PrefetchLoader(
+            (np.full((2,), i, np.float32) for i in range(1000)),
+            buffer_size=2))
+        next(it)
+        it.close()
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline:
+            alive = [t for t in threading.enumerate()
+                     if t.name == "apex-tpu-prefetch" and t.is_alive()]
+            if not alive:
+                break
+            time.sleep(0.05)
+        assert not alive, "prefetch worker leaked after early exit"
+
+    def test_source_closed_on_early_exit(self):
+        closed = []
+
+        def gen():
+            try:
+                for i in range(100):
+                    yield np.full((2,), i, np.float32)
+            finally:
+                closed.append(True)
+
+        it = iter(PrefetchLoader(gen(), buffer_size=1))
+        next(it)
+        it.close()
+        assert closed == [True]
