@@ -89,9 +89,13 @@ class PrefetchLoader:
         finally:
             stop.set()
             t.join(timeout=5.0)
-            close = getattr(self._source, "close", None)
-            if callable(close):
-                close()
+            # only close the source once the worker is truly done —
+            # closing a generator mid-next() from another thread raises
+            # "generator already executing"
+            if not t.is_alive():
+                close = getattr(self._source, "close", None)
+                if callable(close):
+                    close()
 
 
 def prefetch_to_device(iterator: Iterable[Any], size: int = 2,

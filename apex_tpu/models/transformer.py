@@ -64,6 +64,17 @@ class TransformerConfig:
     # parallel / compile behavior
     sequence_parallel: bool = False
     remat: bool = False
+    # jax.checkpoint policy when remat=True: a jax.checkpoint_policies
+    # attr name ("nothing_saveable" = full recompute, min memory;
+    # "dots_with_no_batch_dims_saveable" = save GEMM outputs), or
+    # "save_only:<name>[,<name>...]" to keep just the named residuals
+    # (e.g. "save_only:attn_out" skips recomputing attention in bwd for
+    # b·s·h bf16 per layer of memory).
+    remat_policy: str = "nothing_saveable"
+    # flash-attention kernel tile sizes (isolated-op sweeps can mislead:
+    # in the full rematted model 512/512 measures fastest at s=512)
+    attention_block_q: int = 512
+    attention_block_k: int = 512
     scan_layers: bool = True
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -96,6 +107,13 @@ class TransformerConfig:
         if self.norm not in ("layernorm", "rmsnorm"):
             raise ValueError(
                 f"norm={self.norm!r} not in ('layernorm', 'rmsnorm')")
+
+
+def _remat_policy(spec: str):
+    if spec.startswith("save_only:"):
+        names = spec[len("save_only:"):].split(",")
+        return jax.checkpoint_policies.save_only_these_names(*names)
+    return getattr(jax.checkpoint_policies, spec)
 
 
 def _norm(cfg: TransformerConfig, name: str):
@@ -142,7 +160,14 @@ class ParallelAttention(nn.Module):
             cos, sin = rope_cos_sin(s, rot, base=cfg.rope_base)
             q = fused_rope(q, cos, sin)
             k = fused_rope(k, cos, sin)
-        o = fused_attention(q, k, v, causal=cfg.causal, bias=mask_bias)
+        o = fused_attention(q, k, v, causal=cfg.causal, bias=mask_bias,
+                            block_q=cfg.attention_block_q,
+                            block_k=cfg.attention_block_k)
+        # named so remat_policy="save_only:attn_out" can keep the flash
+        # output (cheap: b·s·h bf16) and skip recomputing the whole
+        # attention in backward
+        from jax.ad_checkpoint import checkpoint_name
+        o = checkpoint_name(o, "attn_out")
         if cfg.attention_dropout > 0.0 and not deterministic:
             o = nn.Dropout(rate=cfg.attention_dropout)(
                 o, deterministic=False)
@@ -243,7 +268,7 @@ class ParallelTransformer(nn.Module):
             if cfg.remat:
                 block_cls = nn.remat(
                     block_cls, prevent_cse=False,
-                    policy=jax.checkpoint_policies.nothing_saveable)
+                    policy=_remat_policy(cfg.remat_policy))
             stack = nn.scan(
                 block_cls,
                 variable_axes={"params": 0},
@@ -258,7 +283,7 @@ class ParallelTransformer(nn.Module):
             if cfg.remat:
                 layer_cls = nn.remat(
                     layer_cls, prevent_cse=False,
-                    policy=jax.checkpoint_policies.nothing_saveable)
+                    policy=_remat_policy(cfg.remat_policy))
             for i in range(cfg.num_layers):
                 x = layer_cls(cfg, name=f"layer_{i}")(
                     x, mask_bias=mask_bias, deterministic=deterministic)

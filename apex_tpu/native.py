@@ -35,7 +35,9 @@ def flatten_host_buffers(arrays: Sequence[np.ndarray]) -> np.ndarray:
         return np.frombuffer(_apex_C.flatten(arrs), np.uint8)
     if not arrs:
         return np.empty((0,), np.uint8)
-    return np.concatenate([a.view(np.uint8).reshape(-1) for a in arrs])
+    # reshape before the uint8 view: 0-d arrays reject dtype-size-
+    # changing views
+    return np.concatenate([a.reshape(-1).view(np.uint8) for a in arrs])
 
 
 def unflatten_host_buffer(flat: np.ndarray,
@@ -52,6 +54,9 @@ def unflatten_host_buffer(flat: np.ndarray,
     out, off = [], 0
     view = flat.view(np.uint8).reshape(-1)
     for a in like:
-        out.append(view[off:off + a.nbytes].view(a.dtype).reshape(a.shape))
+        # copy so outputs never alias the input (the native path returns
+        # independent buffers; the fallback must behave identically)
+        chunk = view[off:off + a.nbytes].copy()
+        out.append(chunk.view(a.dtype).reshape(a.shape))
         off += a.nbytes
     return out

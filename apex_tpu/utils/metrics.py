@@ -19,15 +19,24 @@ _logger = logging.getLogger("apex_tpu.metrics")
 
 
 class MetricsWriter:
-    """Collects scalar metrics; pluggable sink (logger, file, list)."""
+    """Collects scalar metrics; pluggable sink (logger, file, list).
+
+    Callback *delivery* order is not guaranteed by JAX when several
+    jitted emissions are in flight (ordered callbacks are unsupported on
+    multi-device computations), so ``history`` is kept sorted by step on
+    insertion; sinks that need strict order should read ``history``
+    after a ``jax.effects_barrier()`` instead of streaming.
+    """
 
     def __init__(self, sink: Optional[Callable[[int, Dict[str, float]], None]] = None):
         self.history: list = []
         self._sink = sink
 
     def __call__(self, step: int, metrics: Dict[str, Any]) -> None:
+        import bisect
+
         row = {k: float(v) for k, v in metrics.items()}
-        self.history.append((int(step), row))
+        bisect.insort(self.history, (int(step), row), key=lambda r: r[0])
         if self._sink is not None:
             self._sink(int(step), row)
         else:
@@ -40,6 +49,8 @@ def log_metrics(writer: MetricsWriter, step, metrics: Dict[str, Any]) -> None:
 
     ``jax.debug.callback`` ships the (tiny) scalars to the host without
     blocking the device — the TPU-friendly version of the reference
-    examples' per-step prints.
+    examples' per-step prints.  Delivery is unordered (ordered effects
+    don't exist on multi-device computations); ``MetricsWriter.history``
+    is sorted by step on insertion to compensate.
     """
     jax.debug.callback(writer, step, metrics)

@@ -1,0 +1,37 @@
+"""Ad-hoc perf sweep for the north-star config (O2 path only).
+
+Usage: BENCH_BATCH=32 BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable
+       python bench_sweep.py
+Fresh process per config (HBM is not reclaimed promptly across builds).
+"""
+
+import json
+import os
+
+import bench
+
+
+def main():
+    import jax.numpy as jnp
+
+    cfg_kw = {
+        "remat": os.environ.get("BENCH_REMAT", "1") == "1",
+        "remat_policy": os.environ.get("BENCH_REMAT_POLICY",
+                                       "nothing_saveable"),
+        "dtype": jnp.bfloat16,
+    }
+    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
+    state, step, batch, b = bench._build(cfg_kw, "O2", jnp.bfloat16,
+                                         fused=True)
+    dt, loss, finite = bench._measure(state, step, batch, n_steps)
+    print(json.dumps({
+        "batch": b,
+        "remat_policy": cfg_kw["remat_policy"] if cfg_kw["remat"] else None,
+        "step_ms": round(dt * 1e3, 2),
+        "samples_per_sec": round(b / dt, 2),
+        "finite": finite,
+    }))
+
+
+if __name__ == "__main__":
+    main()
