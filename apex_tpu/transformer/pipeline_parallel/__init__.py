@@ -7,16 +7,20 @@ p2p_communication + utils).  See :mod:`.schedules` for the TPU design
 
 from apex_tpu.transformer.pipeline_parallel.schedules import (
     spmd_pipeline,
+    spmd_pipeline_interleaved,
     forward_backward_no_pipelining,
     forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
     get_forward_backward_func,
 )
 from apex_tpu.transformer.pipeline_parallel import p2p
 
 __all__ = [
     "spmd_pipeline",
+    "spmd_pipeline_interleaved",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
     "get_forward_backward_func",
     "p2p",
 ]

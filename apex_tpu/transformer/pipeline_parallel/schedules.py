@@ -47,8 +47,10 @@ from apex_tpu.transformer.pipeline_parallel.p2p import (
 
 __all__ = [
     "spmd_pipeline",
+    "spmd_pipeline_interleaved",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
     "get_forward_backward_func",
 ]
 
@@ -128,6 +130,108 @@ def spmd_pipeline(
 
 
 # --------------------------------------------------------------------- #
+# interleaved (virtual pipeline) variant — the circular schedule
+# --------------------------------------------------------------------- #
+def spmd_pipeline_interleaved(
+    stage_fn: Callable,
+    stage_params: Any,
+    microbatches: jnp.ndarray,
+    *,
+    axis: str = PIPE_AXIS,
+    remat: bool = True,
+):
+    """Virtual-pipeline forward: each rank holds ``V`` model chunks.
+
+    Reference: ``fwd_bwd_pipelining_with_interleaving.py`` — global
+    stage ``s = c*pp + r`` lives on rank ``r`` as chunk ``c``, and a
+    microbatch circles the ring ``V`` times; the bubble shrinks from
+    ``(pp-1)/M`` to ``(pp-1)/(V·M)`` ticks.
+
+    TPU form: one ``lax.scan`` over ``M·V + pp - 1`` ticks.  Item
+    ``i = t - rank`` enumerates (group g, lap c, slot j) in the order
+    ``i = g·V·pp + c·pp + j`` with microbatch ``m = g·pp + j`` — chosen
+    so a microbatch leaving rank ``pp-1`` on lap ``c`` re-enters rank 0
+    on lap ``c+1`` exactly one tick later: the wrap link of the same
+    ``ppermute`` ring IS the lap hand-off, every rank is busy every
+    valid tick, and no inter-lap buffering exists.  Requires
+    ``M % pp == 0`` (the reference's interleaved constraint).  Backward
+    is the transposed scan, as in :func:`spmd_pipeline`.
+
+    ``stage_params`` per rank: leading axes ``(V, 1, ...)`` — a
+    ``(V, pp, ...)`` global stack split over ``axis`` on dim 1.
+    Returns ``(M, mb, seq, hidden)`` last-lap outputs, replicated.
+    """
+    pp = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    num_micro = microbatches.shape[0]
+    if num_micro % pp:
+        raise ValueError(
+            f"interleaved schedule requires num_microbatches "
+            f"({num_micro}) % pipeline size ({pp}) == 0 "
+            f"(reference constraint)")
+
+    # strip the split pp dim (local size 1) from the (V, pp, ...) stack;
+    # 0-d leaves are replicated scalars shared by every chunk (same
+    # convention as spmd_pipeline), anything else must carry the stack
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.ndim == 1 or (leaf.ndim >= 2 and leaf.shape[1] != 1):
+            raise ValueError(
+                f"stage_params leaves must be (V, pp, ...) stacks with "
+                f"dim 1 split over '{axis}' to local size 1, or 0-d "
+                f"replicated scalars; got local shape {leaf.shape} — "
+                f"pass params_spec=P(None, '{axis}', ...)")
+    stage_params = jax.tree.map(
+        lambda a: a[:, 0] if a.ndim >= 2 else a, stage_params)
+    stacked = [l for l in jax.tree.leaves(stage_params) if l.ndim]
+    if not stacked:
+        raise ValueError("stage_params has no stacked (V, pp, ...) leaf")
+    v = stacked[0].shape[0]
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(
+            stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+    n_items = num_micro * v
+    n_ticks = n_items + pp - 1
+
+    def tick(carry, t):
+        recv = carry
+        i = t - rank                       # this rank's item index
+        iv = jnp.clip(i, 0, n_items - 1)
+        g = iv // (v * pp)
+        rem = iv % (v * pp)
+        c = rem // pp                      # lap / chunk index
+        j = rem % pp
+        m = g * pp + j                     # microbatch index
+        mb = lax.dynamic_index_in_dim(microbatches, m, axis=0,
+                                      keepdims=False)
+        # rank 0 injects fresh microbatches on lap 0; all other
+        # (rank, lap) combinations consume the ring hand-off
+        x = jnp.where((rank == 0) & (c == 0), mb, recv)
+        chunk_params = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(
+                a, c, axis=0, keepdims=False) if a.ndim else a,
+            stage_params)
+        y = body(chunk_params, x)
+        nxt = send_forward_recv_forward(y, axis=axis)
+        return nxt, y
+
+    init = jnp.zeros_like(microbatches[0])
+    init = lax.pcast(init, (axis,), to="varying")
+    _, ys = lax.scan(tick, init, jnp.arange(n_ticks))
+
+    # final output of microbatch m = (g, j): item g·V·pp + (V-1)·pp + j
+    # finishes on rank pp-1 at tick item + pp - 1
+    ms = jnp.arange(num_micro)
+    out_ticks = (ms // pp) * (v * pp) + (v - 1) * pp + (ms % pp) + pp - 1
+    outs = jnp.take(ys, out_ticks, axis=0)
+    outs = lax.psum(
+        jnp.where(rank == pp - 1, outs, jnp.zeros_like(outs)), axis)
+    return outs
+
+
+# --------------------------------------------------------------------- #
 # reference-named drivers
 # --------------------------------------------------------------------- #
 def forward_backward_no_pipelining(
@@ -166,6 +270,43 @@ def forward_backward_no_pipelining(
     return loss_sum * inv, jax.tree.map(lambda g: g * inv, grad_sum)
 
 
+def _pipelined_value_and_grad(
+    pipeline_fn: Callable,
+    default_pspec: Callable[[str], P],
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Any,
+    batch: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    num_microbatches: Optional[int],
+    axis: str,
+    remat: bool,
+    params_spec: Optional[Any],
+):
+    """Shared driver for both pipeline schedules: shard_map over the
+    pipe axis, vmap the loss over last-stage outputs, value_and_grad."""
+    m = num_microbatches or get_num_microbatches()
+    mbs = batch.reshape(m, batch.shape[0] // m, *batch.shape[1:])
+    pspec = params_spec if params_spec is not None else default_pspec(axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=P(),
+        # only `pipe` goes manual: data/tensor axes inside the stage
+        # remain GSPMD-managed, so TP layers compose with the pipeline.
+        # check_vma must stay on — with it off, grad-of-partial-manual
+        # shard_map fails out_specs validation on inferred residuals
+        axis_names={axis})
+    def pipelined_loss(params_local, mbs_local):
+        outs = pipeline_fn(stage_fn, params_local, mbs_local,
+                           axis=axis, remat=remat)
+        losses = jax.vmap(loss_fn)(outs, jnp.arange(m))
+        return jnp.mean(losses)
+
+    return jax.value_and_grad(pipelined_loss)(stage_params, mbs)
+
+
 def forward_backward_pipelining_without_interleaving(
     stage_fn: Callable,
     loss_fn: Callable,
@@ -187,25 +328,39 @@ def forward_backward_pipelining_without_interleaving(
     ``batch``: ``(M * mb, seq, hidden)``.  Returns ``(loss, grads)``
     with ``grads`` matching ``stage_params``.
     """
-    m = num_microbatches or get_num_microbatches()
-    mbs = batch.reshape(m, batch.shape[0] // m, *batch.shape[1:])
-    pspec = params_spec if params_spec is not None else P(axis)
+    return _pipelined_value_and_grad(
+        spmd_pipeline, lambda ax: P(ax),
+        stage_fn, loss_fn, stage_params, batch, mesh=mesh,
+        num_microbatches=num_microbatches, axis=axis, remat=remat,
+        params_spec=params_spec)
 
-    @functools.partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(pspec, P()), out_specs=P(),
-        # only `pipe` goes manual: data/tensor axes inside the stage
-        # remain GSPMD-managed, so TP layers compose with the pipeline.
-        # check_vma must stay on — with it off, grad-of-partial-manual
-        # shard_map fails out_specs validation on inferred residuals
-        axis_names={axis})
-    def pipelined_loss(params_local, mbs_local):
-        outs = spmd_pipeline(stage_fn, params_local, mbs_local,
-                             axis=axis, remat=remat)
-        losses = jax.vmap(loss_fn)(outs, jnp.arange(m))
-        return jnp.mean(losses)
 
-    return jax.value_and_grad(pipelined_loss)(stage_params, mbs)
+def forward_backward_pipelining_with_interleaving(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Any,
+    batch: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    num_microbatches: Optional[int] = None,
+    axis: str = PIPE_AXIS,
+    remat: bool = True,
+    params_spec: Optional[Any] = None,
+):
+    """Interleaved pipelined forward+backward (reference:
+    ``fwd_bwd_pipelining_with_interleaving.py``).
+
+    Like :func:`forward_backward_pipelining_without_interleaving`, but
+    ``stage_params`` carries a leading ``(V, pp)`` double stack — chunk
+    ``c`` on rank ``r`` implements global stage ``c*pp + r`` — so each
+    microbatch makes ``V`` laps around the ring.  Requires
+    ``num_microbatches % pp == 0``.
+    """
+    return _pipelined_value_and_grad(
+        spmd_pipeline_interleaved, lambda ax: P(None, ax),
+        stage_fn, loss_fn, stage_params, batch, mesh=mesh,
+        num_microbatches=num_microbatches, axis=axis, remat=remat,
+        params_spec=params_spec)
 
 
 def get_forward_backward_func(
@@ -217,10 +372,6 @@ def get_forward_backward_func(
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None \
                 and virtual_pipeline_model_parallel_size > 1:
-            raise NotImplementedError(
-                "interleaved (virtual) pipeline schedule: pending — the "
-                "collective SPMD schedule covers the non-interleaved "
-                "1F1B cost model; virtual stages need the circular "
-                "variant")
+            return forward_backward_pipelining_with_interleaving
         return forward_backward_pipelining_without_interleaving
     return forward_backward_no_pipelining

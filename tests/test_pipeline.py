@@ -141,12 +141,14 @@ class TestPipelineSchedule:
                                    atol=1e-6)
 
     def test_dispatch(self):
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_with_interleaving)
         assert get_forward_backward_func(1) is \
             forward_backward_no_pipelining
         assert get_forward_backward_func(2) is \
             forward_backward_pipelining_without_interleaving
-        with pytest.raises(NotImplementedError):
-            get_forward_backward_func(2, 2)
+        assert get_forward_backward_func(2, 2) is \
+            forward_backward_pipelining_with_interleaving
 
 
 class TestMicrobatchCalculator:
@@ -202,3 +204,75 @@ class TestP2P:
         # rank r receives rank r-1's value (wrap)
         np.testing.assert_array_equal(np.asarray(got),
                                       np.roll(np.arange(pp), 1))
+
+
+def _stacked_params_vpp(rng, v, pp):
+    return (
+        jnp.asarray(rng.normal(size=(v, pp, HID, HID)) * 0.3, jnp.float32),
+        jnp.asarray(rng.normal(size=(v, pp, HID)) * 0.1, jnp.float32),
+        jnp.asarray(rng.normal(size=(v, pp, HID, HID)) * 0.3, jnp.float32),
+    )
+
+
+def _sequential_reference_vpp(stacked, batch, m):
+    """Ground truth for the virtual pipeline: stages in global order
+    s = c*pp + r (lap-major, the Megatron chunk assignment)."""
+    v, pp = stacked[0].shape[:2]
+    mbs = batch.reshape(m, -1, SEQ, HID)
+
+    def full_model(stacked, x):
+        for c in range(v):
+            for r in range(pp):
+                x = _stage_fn(jax.tree.map(lambda t: t[c, r], stacked), x)
+        return x
+
+    def loss(stacked):
+        outs = jax.vmap(lambda mb: full_model(stacked, mb))(mbs)
+        return jnp.mean(outs ** 2)
+
+    return jax.value_and_grad(loss)(stacked)
+
+
+class TestInterleavedSchedule:
+    @pytest.mark.parametrize("v,m", [(2, 2), (2, 4), (3, 4)])
+    def test_matches_sequential(self, rng, mesh8, v, m):
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_with_interleaving)
+        pp = mesh8.shape[PIPE_AXIS]
+        stacked = _stacked_params_vpp(rng, v, pp)
+        batch = jnp.asarray(rng.normal(size=(m * MB, SEQ, HID)),
+                            jnp.float32)
+
+        def loss_fn(y, idx):
+            return jnp.mean(y ** 2)
+
+        loss, grads = forward_backward_pipelining_with_interleaving(
+            _stage_fn, loss_fn, stacked, batch, mesh=mesh8,
+            num_microbatches=m)
+        want_loss, want_grads = _sequential_reference_vpp(stacked, batch, m)
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=1e-5)
+        for g, wg in zip(jax.tree.leaves(grads),
+                         jax.tree.leaves(want_grads)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(wg),
+                                       rtol=2e-4, atol=1e-5)
+
+    def test_requires_divisible_microbatches(self, rng, mesh8):
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_with_interleaving)
+        pp = mesh8.shape[PIPE_AXIS]
+        stacked = _stacked_params_vpp(rng, 2, pp)
+        batch = jnp.asarray(rng.normal(size=(3 * MB, SEQ, HID)),
+                            jnp.float32)
+        with pytest.raises(ValueError, match="interleaved"):
+            forward_backward_pipelining_with_interleaving(
+                _stage_fn, lambda y, i: jnp.mean(y ** 2), stacked,
+                batch, mesh=mesh8, num_microbatches=3)
+
+    def test_dispatch(self):
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_with_interleaving)
+        f = get_forward_backward_func(
+            pipeline_model_parallel_size=2,
+            virtual_pipeline_model_parallel_size=2)
+        assert f is forward_backward_pipelining_with_interleaving
