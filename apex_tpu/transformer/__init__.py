@@ -9,6 +9,7 @@ groups; collectives via GSPMD sharding or explicit shard_map mappings.
 from apex_tpu.transformer import data
 from apex_tpu.transformer import log_util
 from apex_tpu.transformer import microbatches
+from apex_tpu.transformer import moe
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer import pipeline_parallel
 from apex_tpu.transformer import mappings
@@ -23,6 +24,7 @@ from apex_tpu.transformer.layers import (
 )
 from apex_tpu.transformer.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.transformer.data import broadcast_data
+from apex_tpu.transformer.moe import MoEConfig, MoEMLP
 from apex_tpu.transformer.microbatches import (
     setup_microbatch_calculator,
     get_num_microbatches,
@@ -41,7 +43,8 @@ from apex_tpu.transformer.enums import (
 
 __all__ = [
     "parallel_state", "mappings", "random", "data", "log_util",
-    "microbatches", "pipeline_parallel", "broadcast_data",
+    "microbatches", "moe", "pipeline_parallel", "broadcast_data",
+    "MoEConfig", "MoEMLP",
     "setup_microbatch_calculator", "get_num_microbatches",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "column_parallel_linear", "row_parallel_linear",

@@ -1,0 +1,118 @@
+"""MoE gating/dispatch golden tests + expert-parallel sharding
+(beyond-reference extension; EP absent in apex — SURVEY.md §2.6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.core import mesh as mesh_lib
+from apex_tpu.transformer.moe import MoEConfig, MoEMLP, top_k_gating
+
+
+class TestGating:
+    def test_top1_routes_to_argmax(self, rng):
+        logits = jnp.asarray(rng.normal(size=(12, 4)), jnp.float32)
+        dispatch, combine, aux = top_k_gating(logits, k=1, capacity=12)
+        choice = np.argmax(np.asarray(logits), axis=-1)
+        d = np.asarray(dispatch)
+        for t in range(12):
+            assert d[t].sum() == 1.0
+            assert d[t, choice[t]].sum() == 1.0
+        # k=1 keeps the raw gate probability (Switch semantics — the
+        # router's task-loss gradient flows through this scale)
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        np.testing.assert_allclose(
+            np.asarray(combine).sum(axis=(1, 2)),
+            probs[np.arange(12), choice], rtol=1e-6)
+        assert np.isfinite(float(aux))
+
+    def test_capacity_drops_overflow(self):
+        # all tokens prefer expert 0; capacity 2 keeps first 2 only
+        logits = jnp.tile(jnp.asarray([[5.0, 0.0]]), (6, 1))
+        dispatch, combine, _ = top_k_gating(logits, k=1, capacity=2)
+        d = np.asarray(dispatch)
+        assert d[:, 0].sum() == 2.0          # two tokens kept
+        np.testing.assert_array_equal(d[2:].sum(axis=(1, 2)), 0.0)
+
+    def test_top2_distinct_experts(self, rng):
+        logits = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+        dispatch, _, _ = top_k_gating(logits, k=2, capacity=16)
+        d = np.asarray(dispatch).sum(axis=2)  # (T, E)
+        assert (d.sum(axis=1) == 2.0).all()
+        assert (d <= 1.0).all()               # two different experts
+
+
+class TestMoEMLP:
+    def test_matches_manual_expert_computation(self, rng):
+        cfg = MoEConfig(num_experts=4, top_k=1, hidden_size=8,
+                        ffn_hidden_size=16, capacity_factor=4.0,
+                        expert_axis=None)
+        m = MoEMLP(cfg)
+        x = jnp.asarray(rng.normal(size=(2, 3, 8)), jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+        (y, aux) = m.apply(v, x)
+        p = v["params"]
+        xt = np.asarray(x).reshape(6, 8)
+        logits = xt @ np.asarray(p["gate"])
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        choice = logits.argmax(-1)
+        want = np.zeros((6, 8), np.float32)
+        for t in range(6):
+            e = choice[t]
+            h = xt[t] @ np.asarray(p["w1"])[e] + np.asarray(p["b1"])[e]
+            h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+            out = h @ np.asarray(p["w2"])[e] + np.asarray(p["b2"])[e]
+            # Switch semantics: top-1 output scaled by the gate prob
+            want[t] = probs[t, e] * out
+        np.testing.assert_allclose(np.asarray(y).reshape(6, 8), want,
+                                   rtol=2e-3, atol=2e-4)
+        assert np.isfinite(float(aux))
+
+    def test_expert_parallel_matches_single_device(self, rng):
+        cfg = MoEConfig(num_experts=4, top_k=2, hidden_size=8,
+                        ffn_hidden_size=16, capacity_factor=2.0,
+                        expert_axis="tensor")
+        m = MoEMLP(cfg)
+        x = jnp.asarray(rng.normal(size=(2, 4, 8)), jnp.float32)
+        mesh = mesh_lib.initialize_mesh(tensor_model_parallel_size=4,
+                                        data_parallel_size=2)
+        try:
+            with jax.set_mesh(mesh):
+                v = jax.jit(m.init)(jax.random.PRNGKey(0), x)
+                y_sh, aux_sh = jax.jit(m.apply)(v, x)
+            # unsharded replay of the same params
+            v_local = jax.tree.map(
+                lambda a: np.asarray(a),
+                jax.device_get(jax.tree.map(
+                    lambda a: a.value if hasattr(a, "value") else a, v)))
+            m_local = MoEMLP(
+                MoEConfig(**{**cfg.__dict__, "expert_axis": None}))
+            y_loc, aux_loc = m_local.apply(
+                jax.tree.map(jnp.asarray, v_local), x)
+            np.testing.assert_allclose(np.asarray(y_sh),
+                                       np.asarray(y_loc),
+                                       rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(float(aux_sh), float(aux_loc),
+                                       rtol=1e-5)
+        finally:
+            mesh_lib.destroy_mesh()
+
+    def test_grads_flow(self, rng):
+        cfg = MoEConfig(num_experts=2, top_k=1, hidden_size=4,
+                        ffn_hidden_size=8, capacity_factor=4.0,
+                        expert_axis=None)
+        m = MoEMLP(cfg)
+        x = jnp.asarray(rng.normal(size=(1, 4, 4)), jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+
+        def loss(p):
+            y, aux = m.apply({"params": p}, x)
+            return jnp.mean(y ** 2) + aux
+
+        g = jax.grad(loss)(v["params"])
+        for leaf in jax.tree.leaves(g):
+            assert np.all(np.isfinite(np.asarray(leaf)))
+        # gate must receive gradient (through combine weights + aux)
+        assert float(jnp.sum(jnp.abs(g["gate"]))) > 0.0
