@@ -196,14 +196,8 @@ class ParallelMLP(nn.Module):
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name="dense_h_to_4h")(x)
-        if cfg.activation == "gelu":
-            y = jax.nn.gelu(y, approximate=True)
-        elif cfg.activation == "relu":
-            y = jax.nn.relu(y)
-        elif cfg.activation == "silu":
-            y = jax.nn.silu(y)
-        else:
-            raise ValueError(f"unknown activation {cfg.activation!r}")
+        from apex_tpu.ops.mlp import resolve_activation
+        y = resolve_activation(cfg.activation, gelu_approximate=True)(y)
         return RowParallelLinear(
             features=cfg.hidden_size, use_bias=True,
             sequence_parallel=cfg.sequence_parallel,

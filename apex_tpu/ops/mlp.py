@@ -26,26 +26,38 @@ import flax.linen as nn
 __all__ = ["FusedDense", "FusedDenseGeluDense", "MLP", "fused_dense"]
 
 
+def resolve_activation(name: Optional[str], *,
+                       gelu_approximate: bool = False):
+    """Shared activation-name resolver (single source for every module
+    that takes an ``activation`` string — fused_dense, ParallelMLP,
+    MoEMLP).  ``None`` resolves to identity."""
+    if name is None:
+        return lambda y: y
+    if name == "gelu":
+        return lambda y: jax.nn.gelu(y, approximate=gelu_approximate)
+    if name == "relu":
+        return jax.nn.relu
+    if name == "silu":
+        return jax.nn.silu
+    if name == "sigmoid":
+        return jax.nn.sigmoid
+    raise ValueError(f"unknown activation {name!r}")
+
+
 def fused_dense(x, kernel, bias=None, activation: Optional[str] = None):
     """dense(+bias)(+activation) as one fusable expression.
 
     fp32 accumulation on the MXU; output in ``x.dtype`` (reference:
     ``fused_dense_cuda`` runs fp16 GEMM with fp32 accumulate).
     """
+    act = resolve_activation(activation)
     y = jax.lax.dot_general(
         x, kernel,
         dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     if bias is not None:
         y = y + bias.astype(jnp.float32)
-    if activation == "gelu":
-        y = jax.nn.gelu(y, approximate=False)
-    elif activation == "relu":
-        y = jax.nn.relu(y)
-    elif activation == "sigmoid":
-        y = jax.nn.sigmoid(y)
-    elif activation is not None:
-        raise ValueError(f"unknown activation {activation!r}")
+    y = act(y)
     return y.astype(x.dtype)
 
 

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from apex_tpu.core.mesh import TENSOR_AXIS
+from apex_tpu.ops.mlp import resolve_activation
 
 __all__ = ["MoEConfig", "top_k_gating", "MoEMLP"]
 
@@ -64,6 +65,10 @@ def top_k_gating(logits: jax.Array, k: int, capacity: int
     order via a cumulative count.
     """
     t, e = logits.shape
+    if k > e:
+        raise ValueError(
+            f"top_k ({k}) cannot exceed num_experts ({e}) — later "
+            f"routing rounds would silently double-route to expert 0")
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     dispatch = jnp.zeros((t, e, capacity), jnp.float32)
@@ -101,16 +106,6 @@ def top_k_gating(logits: jax.Array, k: int, capacity: int
     return dispatch, combine, aux
 
 
-def _activation(name: str):
-    if name == "gelu":
-        return jax.nn.gelu
-    if name == "relu":
-        return jax.nn.relu
-    if name == "silu":
-        return jax.nn.silu
-    raise ValueError(f"unknown activation {name!r}")
-
-
 class MoEMLP(nn.Module):
     """MoE FFN block: gate → dispatch → stacked expert MLPs → combine.
 
@@ -131,7 +126,12 @@ class MoEMLP(nn.Module):
         cfg = self.cfg
         b, s, h = x.shape
         e = cfg.num_experts
-        capacity = max(1, int(cfg.capacity_factor * s * cfg.top_k / e))
+        # ceil, not floor: the documented contract is "at least
+        # cf·S·k/E slots"; truncation would drop tokens at nearly
+        # double the configured rate at small S
+        import math
+        capacity = max(1, math.ceil(
+            cfg.capacity_factor * s * cfg.top_k / e))
 
         gate_w = self.param("gate", nn.initializers.normal(0.02),
                             (h, e), cfg.param_dtype)
@@ -164,7 +164,7 @@ class MoEMLP(nn.Module):
         # E-sharded contraction into the token all-to-all
         xin = jnp.einsum("gsec,gsh->gech", dispatch.astype(cfg.dtype),
                          x.astype(cfg.dtype))
-        act = _activation(cfg.activation)
+        act = resolve_activation(cfg.activation, gelu_approximate=True)
         hmid = act(jnp.einsum(
             "gech,ehf->gecf", xin, w1.astype(cfg.dtype),
             preferred_element_type=jnp.float32)

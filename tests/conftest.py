@@ -28,7 +28,7 @@ import pytest  # noqa: E402
 # before this conftest ran, making the env var above a no-op.  Setting
 # the config directly still works as long as no backend has been used.
 jax.config.update("jax_platforms", _platform)
-_want = {"cuda": "gpu", "rocm": "gpu"}.get(
+_want = {"cuda": "gpu", "rocm": "gpu", "axon": "tpu"}.get(
     _platform.split(",")[0], _platform.split(",")[0])
 assert jax.default_backend() == _want, (
     f"test suite must run on {_want}, got {jax.default_backend()}")

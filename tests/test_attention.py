@@ -14,6 +14,15 @@ from apex_tpu.ops.attention import fused_attention, attention_reference
 D = 128
 
 
+@pytest.fixture(autouse=True)
+def _true_fp32_matmuls():
+    """Pin fp32 matmuls: on TPU, DEFAULT precision runs the *reference
+    composition* in bf16 MXU passes (~1e-2 error), which would fail the
+    kernel-vs-golden tolerances for hardware reasons, not math."""
+    with jax.default_matmul_precision("highest"):
+        yield
+
+
 def _qkv(rng, b=2, sq=256, sk=256, h=2, hk=None, dtype=jnp.float32):
     hk = hk or h
     q = jnp.asarray(rng.normal(size=(b, sq, h, D)), dtype)

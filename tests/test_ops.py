@@ -198,8 +198,11 @@ class TestMLP:
         x_np = rng.normal(size=(4, 32)).astype(np.float32)
         w_np = rng.normal(size=(32, 16)).astype(np.float32)
         b_np = rng.normal(size=(16,)).astype(np.float32)
-        got = ops.fused_dense(jnp.asarray(x_np), jnp.asarray(w_np),
-                              jnp.asarray(b_np))
+        # pin true-fp32 matmul: TPU's DEFAULT precision runs bf16 passes
+        # (~1e-2 error), which is hardware behavior, not op math
+        with jax.default_matmul_precision("highest"):
+            got = ops.fused_dense(jnp.asarray(x_np), jnp.asarray(w_np),
+                                  jnp.asarray(b_np))
         want = torch.nn.functional.linear(
             torch.tensor(x_np), torch.tensor(w_np).T, torch.tensor(b_np))
         np.testing.assert_allclose(np.asarray(got), want.numpy(),
