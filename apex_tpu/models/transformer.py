@@ -29,6 +29,7 @@ import flax.linen as nn
 from apex_tpu.core.mesh import TENSOR_AXIS
 from apex_tpu.ops.attention import fused_attention
 from apex_tpu.ops.layer_norm import fused_layer_norm, fused_rms_norm
+from apex_tpu.ops.mlp import resolve_activation
 from apex_tpu.ops.rope import fused_rope, rope_cos_sin
 from apex_tpu.transformer.layers import (
     ColumnParallelLinear,
@@ -196,7 +197,6 @@ class ParallelMLP(nn.Module):
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name="dense_h_to_4h")(x)
-        from apex_tpu.ops.mlp import resolve_activation
         y = resolve_activation(cfg.activation, gelu_approximate=True)(y)
         return RowParallelLinear(
             features=cfg.hidden_size, use_bias=True,

@@ -23,16 +23,16 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-__all__ = ["FusedDense", "FusedDenseGeluDense", "MLP", "fused_dense"]
+__all__ = ["FusedDense", "FusedDenseGeluDense", "MLP", "fused_dense",
+           "resolve_activation"]
 
 
-def resolve_activation(name: Optional[str], *,
-                       gelu_approximate: bool = False):
+def resolve_activation(name: str, *, gelu_approximate: bool = False):
     """Shared activation-name resolver (single source for every module
     that takes an ``activation`` string — fused_dense, ParallelMLP,
-    MoEMLP).  ``None`` resolves to identity."""
-    if name is None:
-        return lambda y: y
+    MoEMLP).  Unknown names (including None) raise — an unset
+    activation silently becoming identity would degrade a model with
+    no error; callers with an optional activation check None themselves."""
     if name == "gelu":
         return lambda y: jax.nn.gelu(y, approximate=gelu_approximate)
     if name == "relu":
@@ -50,7 +50,8 @@ def fused_dense(x, kernel, bias=None, activation: Optional[str] = None):
     fp32 accumulation on the MXU; output in ``x.dtype`` (reference:
     ``fused_dense_cuda`` runs fp16 GEMM with fp32 accumulate).
     """
-    act = resolve_activation(activation)
+    act = (lambda y: y) if activation is None \
+        else resolve_activation(activation)
     y = jax.lax.dot_general(
         x, kernel,
         dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),

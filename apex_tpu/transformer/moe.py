@@ -20,6 +20,7 @@ Design (GShard-style dense dispatch, TPU-shaped):
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Optional, Tuple
 
 import jax
@@ -129,7 +130,6 @@ class MoEMLP(nn.Module):
         # ceil, not floor: the documented contract is "at least
         # cf·S·k/E slots"; truncation would drop tokens at nearly
         # double the configured rate at small S
-        import math
         capacity = max(1, math.ceil(
             cfg.capacity_factor * s * cfg.top_k / e))
 

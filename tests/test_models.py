@@ -217,3 +217,23 @@ class TestViT:
         x2 = x.at[:, -8:, -8:].add(3.0)  # perturb the LAST patch
         out1, out2 = m.apply(v, x), m.apply(v, x2)
         assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+
+class TestBertMlmPositions:
+    def test_gathered_logits_match_full(self, rng):
+        from apex_tpu.models import BertConfig, BertModel
+        cfg = BertConfig.tiny()
+        m = BertModel(cfg)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 16)))
+        v = m.init(jax.random.PRNGKey(0), ids)
+        full, pooled_full = m.apply(v, ids)
+        pos = jnp.asarray([[1, 5, 7], [0, 3, 15]])
+        gathered, pooled_g = m.apply(v, ids, mlm_positions=pos)
+        assert gathered.shape == (2, 3, cfg.vocab_size)
+        for b in range(2):
+            for i, p in enumerate(np.asarray(pos)[b]):
+                np.testing.assert_allclose(
+                    np.asarray(gathered[b, i]), np.asarray(full[b, p]),
+                    rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(pooled_g),
+                                   np.asarray(pooled_full), rtol=1e-6)
