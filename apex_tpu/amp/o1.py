@@ -99,12 +99,29 @@ def o1_intercept(half_dtype=jnp.bfloat16):
     def interceptor(next_fn, args, kwargs, context):
         kind = classify_module(type(context.module).__name__)
         if kind == "half":
-            args = _cast_tree(args, half_dtype)
-            kwargs = _cast_tree(kwargs, half_dtype)
+            target = half_dtype
         elif kind == "fp32":
-            args = _cast_tree(args, jnp.float32)
-            kwargs = _cast_tree(kwargs, jnp.float32)
-        return next_fn(*args, **kwargs)
+            target = jnp.float32
+        else:
+            return next_fn(*args, **kwargs)
+        args = _cast_tree(args, target)
+        kwargs = _cast_tree(kwargs, target)
+        # casting inputs is not enough: flax modules with dtype=None
+        # promote with their (fp32) params, so the GEMM would run fp32.
+        # Setting the module's compute dtype casts the *weights* per-op
+        # too — exactly the reference's O1 semantics (fp32 masters, half
+        # compute).  Restore afterwards: for bind()/setup-created bound
+        # modules the instance outlives this call, and the override must
+        # not leak past the amp scope.
+        module = context.module
+        override = getattr(module, "dtype", "__missing__") is None
+        if override:
+            object.__setattr__(module, "dtype", target)
+        try:
+            return next_fn(*args, **kwargs)
+        finally:
+            if override:
+                object.__setattr__(module, "dtype", None)
 
     with nn.intercept_methods(interceptor):
         yield

@@ -45,7 +45,10 @@ def cast_floating(x: Any, dtype: DTypeLike) -> Any:
     """Cast ``x`` to ``dtype`` iff it is a floating-point array; else identity."""
     if dtype is None:
         return x
-    if _is_floating(x):
+    # only cast actual arrays: python floats/ints (default kwargs,
+    # scale factors) pass through untouched, like the reference's
+    # casters which only touch tensors
+    if hasattr(x, "astype") and _is_floating(x):
         return x.astype(dtype)
     return x
 

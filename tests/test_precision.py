@@ -1,6 +1,7 @@
 """Tests for PrecisionPolicy — mirrors the reference's L0/run_amp casting
 checks (opt-level property resolution, model cast, BN exemption)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -96,3 +97,127 @@ class TestCasting:
         np.testing.assert_allclose(
             np.asarray(y["w"], np.float32), np.asarray(x["w"]),
             rtol=2 ** -7)
+
+
+class TestO1Intercept:
+    def test_module_level_casting(self, rng):
+        """Dense runs half, LayerNorm runs fp32 — the module-level
+        analogue of the reference's O1 cast lists."""
+        import flax.linen as nn
+        from apex_tpu.amp import o1
+
+        seen = {}
+
+        class Probe(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Dense(8, name="dense")(x)
+                seen["after_dense"] = x.dtype
+                x = nn.LayerNorm(name="layernorm")(x)
+                seen["after_ln"] = x.dtype
+                return x
+
+        m = Probe()
+        x = jnp.ones((2, 4), jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+        with o1.o1_intercept(jnp.bfloat16):
+            out = m.apply(v, x)
+        # Dense input was cast bf16 → bf16 output; LN input cast fp32
+        assert seen["after_dense"] == jnp.bfloat16
+        assert seen["after_ln"] == jnp.float32
+
+    def test_cast_op_classification(self):
+        from apex_tpu.amp import o1
+        # matmul is a half op; softmax fp32; add promotes
+        y = o1.cast_op("matmul", jnp.matmul,
+                       jnp.ones((2, 2)), jnp.ones((2, 2)),
+                       half_dtype=jnp.bfloat16)
+        assert y.dtype == jnp.bfloat16
+        s = o1.cast_op("softmax", jax.nn.softmax,
+                       jnp.ones((4,), jnp.bfloat16))
+        assert s.dtype == jnp.float32
+        p = o1.cast_op("add", jnp.add, jnp.ones((2,), jnp.bfloat16),
+                       jnp.ones((2,), jnp.float32))
+        assert p.dtype == jnp.float32
+
+    def test_o1_training_converges(self, rng):
+        import flax.linen as nn
+        import optax
+        from apex_tpu import amp
+        from apex_tpu.amp import o1
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.relu(nn.Dense(32)(x))
+                x = nn.LayerNorm()(x)
+                return nn.Dense(1)(x)
+
+        net = Net()
+        X = jnp.asarray(rng.normal(size=(128, 8)), jnp.float32)
+        Y = jnp.sum(X[:, :3], axis=1, keepdims=True)
+        params = net.init(jax.random.PRNGKey(0), X[:2])["params"]
+
+        def apply_fn(p, x):
+            with o1.o1_intercept(jnp.bfloat16):
+                return net.apply({"params": p}, x)
+
+        state = amp.initialize(apply_fn, params, optax.adam(1e-2),
+                               opt_level="O1")
+
+        @jax.jit
+        def step(state, x, y):
+            def loss_fn(p):
+                loss = jnp.mean((state.apply_fn(p, x)
+                                 .astype(jnp.float32) - y) ** 2)
+                return state.scale_loss(loss), loss
+            grads, loss = jax.grad(loss_fn, has_aux=True)(
+                state.compute_params())
+            s, _ = state.apply_gradients(grads=grads)
+            return s, loss
+
+        losses = []
+        for _ in range(40):
+            state, loss = step(state, X, Y)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.2
+
+    def test_override_restored_on_bound_module(self, rng):
+        """bind()-created modules outlive the call — the dtype override
+        must not leak past the amp scope."""
+        import flax.linen as nn
+        from apex_tpu.amp import o1
+
+        class Net(nn.Module):
+            def setup(self):
+                self.d = nn.Dense(4)
+
+            def __call__(self, x):
+                return self.d(x)
+
+        net = Net()
+        x = jnp.ones((2, 4), jnp.float32)
+        v = net.init(jax.random.PRNGKey(0), x)
+        b = net.bind(v)
+        with o1.o1_intercept(jnp.bfloat16):
+            inside = b(x)
+        after = b(x)
+        assert inside.dtype == jnp.bfloat16
+        assert after.dtype == jnp.float32
+
+    def test_scalar_args_pass_through(self, rng):
+        """Plain python float kwargs must not be cast (crash repro)."""
+        import flax.linen as nn
+        from apex_tpu.amp import o1
+
+        class ScaledDense(nn.Module):
+            @nn.compact
+            def __call__(self, x, scale=1.0):
+                return nn.Dense(4)(x) * scale
+
+        m = ScaledDense()
+        x = jnp.ones((2, 4), jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+        with o1.o1_intercept(jnp.bfloat16):
+            out = m.apply(v, x, scale=2.0)
+        assert out.shape == (2, 4)
