@@ -89,7 +89,8 @@ def tree_cast(
         return jax.tree.map(lambda x: cast_floating(x, dtype), tree)
 
     def _cast(path, leaf):
-        if _is_floating(leaf) and keep_fp32_filter(path, leaf):
+        if hasattr(leaf, "astype") and _is_floating(leaf) \
+                and keep_fp32_filter(path, leaf):
             return leaf.astype(jnp.float32)
         return cast_floating(leaf, dtype)
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Any, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -57,11 +58,81 @@ def shard_batch(batch: Any, mesh=None, *,
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
-def all_reduce_mean_grads(grads: Any, axis: str = DATA_AXIS) -> Any:
-    """Explicit grad averaging inside ``shard_map``
+def _normalize_allreduce_dtype(allreduce_dtype: Any):
+    """None | 'int8' | a floating dtype — anything else is an error
+    (an int dtype reaching ``astype`` would silently zero gradients)."""
+    if allreduce_dtype is None:
+        return None
+    if allreduce_dtype == "int8" or (
+            _is_dtype_like(allreduce_dtype)
+            and jnp.dtype(allreduce_dtype) == jnp.dtype(jnp.int8)):
+        return "int8"
+    if _is_dtype_like(allreduce_dtype) and jnp.issubdtype(
+            jnp.dtype(allreduce_dtype), jnp.floating):
+        return jnp.dtype(allreduce_dtype)
+    raise ValueError(
+        f"allreduce_dtype must be None, a floating dtype, or 'int8'; "
+        f"got {allreduce_dtype!r}")
+
+
+def _is_dtype_like(x) -> bool:
+    try:
+        jnp.dtype(x)
+        return True
+    except TypeError:
+        return False
+
+
+def all_reduce_mean_grads(grads: Any, axis: str = DATA_AXIS, *,
+                          allreduce_dtype: Any = None,
+                          average: bool = True) -> Any:
+    """Explicit grad all-reduce inside ``shard_map``
     (``gradient_average=True``; one fused all-reduce like delayed
-    single-bucket mode — bucketing itself is unnecessary under XLA)."""
-    return jax.tree.map(lambda g: lax.pmean(g, axis), grads)
+    single-bucket mode — bucketing itself is unnecessary under XLA).
+    ``average=False`` sums (``gradient_average=False`` parity).
+
+    ``allreduce_dtype`` — communication compression:
+
+    - ``None``: reduce in the grads' dtype (default);
+    - a half dtype (``jnp.bfloat16``/``jnp.float16``): cast before the
+      all-reduce, upcast after — the reference DDP's fp16-allreduce
+      option (halves ICI bytes);
+    - ``"int8"``: EQuARX-style quantized all-reduce (beyond-reference):
+      grads scaled by the *global* amax to int8, summed in int32 (no
+      overflow for < 2^24 replicas), dequantized — ~4× fewer bytes on
+      the wire at ~1/127 amax quantization error.  Non-finite grads
+      come back NaN so dynamic-loss-scale overflow detection still
+      fires (a plain pmean would likewise propagate them).
+    """
+    dtype = _normalize_allreduce_dtype(allreduce_dtype)
+    reduce = lax.pmean if average else lax.psum
+    if dtype is None:
+        return jax.tree.map(lambda g: reduce(g, axis), grads)
+    if dtype == "int8":
+        n = lax.axis_size(axis)
+
+        def q8(g):
+            amax = lax.pmax(jnp.max(jnp.abs(g)).astype(jnp.float32),
+                            axis)
+            scale = jnp.where(amax > 0, 127.0 / amax, 0.0)
+            q = jnp.clip(jnp.round(g.astype(jnp.float32) * scale),
+                         -127, 127).astype(jnp.int32)
+            s = lax.psum(q, axis)
+            deq = s.astype(jnp.float32) * jnp.where(
+                scale > 0, 1.0 / scale, 0.0)
+            if average:
+                deq = deq / n
+            # inf/nan grads must not be masked to zero: overflow
+            # detection (DynamicLossScale) keys off non-finite grads
+            deq = jnp.where(jnp.isfinite(amax), deq, jnp.nan)
+            return deq.astype(g.dtype)
+
+        return jax.tree.map(q8, grads)
+
+    def half(g):
+        return reduce(g.astype(dtype), axis).astype(g.dtype)
+
+    return jax.tree.map(half, grads)
 
 
 class DistributedDataParallel:
@@ -77,9 +148,11 @@ class DistributedDataParallel:
         # all-reduced by XLA exactly where apex's hooks would fire.
     """
 
-    def __init__(self, mesh=None, *, gradient_average: bool = True):
+    def __init__(self, mesh=None, *, gradient_average: bool = True,
+                 allreduce_dtype: Any = None):
         self.mesh = mesh or mesh_lib.get_mesh()
         self.gradient_average = gradient_average
+        self.allreduce_dtype = allreduce_dtype
 
     def replicate(self, params: Any) -> Any:
         return replicate(params, self.mesh)
@@ -88,6 +161,6 @@ class DistributedDataParallel:
         return shard_batch(batch, self.mesh)
 
     def mean_grads(self, grads: Any, axis: str = DATA_AXIS) -> Any:
-        if not self.gradient_average:
-            return jax.tree.map(lambda g: lax.psum(g, axis), grads)
-        return all_reduce_mean_grads(grads, axis)
+        return all_reduce_mean_grads(
+            grads, axis, allreduce_dtype=self.allreduce_dtype,
+            average=self.gradient_average)

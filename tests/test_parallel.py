@@ -192,3 +192,83 @@ class TestDDP:
         specs = zero_param_specs(params, axis="data", mesh=dp_mesh)
         assert specs["w"] == P("data", None)
         assert specs["scalar"] == P()
+
+
+class TestCompressedAllreduce:
+    def test_half_allreduce_close_to_fp32(self, dp_mesh, rng):
+        g = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+
+        def run(dtype):
+            f = shard_map(
+                lambda gs: apx_parallel.all_reduce_mean_grads(
+                    {"g": gs}, allreduce_dtype=dtype)["g"],
+                dp_mesh, (P("data"),), P("data"))
+            return np.asarray(f(g))
+
+        exact = run(None)
+        half = run(jnp.bfloat16)
+        assert half.dtype == np.float32
+        np.testing.assert_allclose(half, exact, rtol=2e-2, atol=2e-2)
+
+    def test_int8_allreduce_quantization_error_bounded(self, dp_mesh, rng):
+        g = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+
+        f = shard_map(
+            lambda gs: apx_parallel.all_reduce_mean_grads(
+                {"g": gs}, allreduce_dtype="int8")["g"],
+            dp_mesh, (P("data"),), P("data"))
+        exact = shard_map(
+            lambda gs: apx_parallel.all_reduce_mean_grads(
+                {"g": gs})["g"],
+            dp_mesh, (P("data"),), P("data"))
+        got, want = np.asarray(f(g)), np.asarray(exact(g))
+        amax = np.abs(np.asarray(g)).max()
+        # per-element error ≤ quantization step (amax/127)
+        assert np.abs(got - want).max() <= amax / 127 + 1e-6
+
+    def test_int8_zero_grads(self, dp_mesh):
+        g = jnp.zeros((16, 4), jnp.float32)
+        f = shard_map(
+            lambda gs: apx_parallel.all_reduce_mean_grads(
+                {"g": gs}, allreduce_dtype="int8")["g"],
+            dp_mesh, (P("data"),), P("data"))
+        np.testing.assert_array_equal(np.asarray(f(g)), 0.0)
+
+    def test_int8_dtype_object_and_validation(self, dp_mesh, rng):
+        g = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+        # jnp.int8 the dtype object routes to the quantized path
+        f = shard_map(
+            lambda gs: apx_parallel.all_reduce_mean_grads(
+                {"g": gs}, allreduce_dtype=jnp.int8)["g"],
+            dp_mesh, (P("data"),), P("data"))
+        out = np.asarray(f(g))
+        assert np.abs(out).max() > 0
+        with pytest.raises(ValueError, match="allreduce_dtype"):
+            apx_parallel.all_reduce_mean_grads(
+                {"g": g}, allreduce_dtype="int4")
+        with pytest.raises(ValueError, match="allreduce_dtype"):
+            apx_parallel.all_reduce_mean_grads(
+                {"g": g}, allreduce_dtype=jnp.int32)
+
+    def test_int8_propagates_nonfinite(self, dp_mesh):
+        g = jnp.full((16, 4), jnp.inf, jnp.float32)
+        f = shard_map(
+            lambda gs: apx_parallel.all_reduce_mean_grads(
+                {"g": gs}, allreduce_dtype="int8")["g"],
+            dp_mesh, (P("data"),), P("data"))
+        out = np.asarray(f(g))
+        assert not np.isfinite(out).any(), \
+            "overflow must survive the quantized all-reduce"
+
+    def test_sum_mode_keeps_compression(self, dp_mesh, rng):
+        g = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+        mean = shard_map(
+            lambda gs: apx_parallel.all_reduce_mean_grads(
+                {"g": gs}, allreduce_dtype="int8")["g"],
+            dp_mesh, (P("data"),), P("data"))(g)
+        total = shard_map(
+            lambda gs: apx_parallel.all_reduce_mean_grads(
+                {"g": gs}, allreduce_dtype="int8", average=False)["g"],
+            dp_mesh, (P("data"),), P("data"))(g)
+        np.testing.assert_allclose(np.asarray(total),
+                                   np.asarray(mean) * 8, rtol=1e-5)

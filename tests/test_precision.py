@@ -221,3 +221,15 @@ class TestO1Intercept:
         with o1.o1_intercept(jnp.bfloat16):
             out = m.apply(v, x, scale=2.0)
         assert out.shape == (2, 4)
+
+
+class TestNonArrayLeaves:
+    def test_tree_cast_passes_python_scalars(self):
+        # keep_fp32_filter branch must not call .astype on raw floats
+        out = tree_cast({"layernorm": {"eps": 1e-6}, "name": "x",
+                         "w": jnp.ones((2,), jnp.float32)},
+                        jnp.bfloat16,
+                        keep_fp32_filter=lambda p, l: "norm" in str(p).lower())
+        assert out["layernorm"]["eps"] == 1e-6
+        assert out["name"] == "x"
+        assert out["w"].dtype == jnp.bfloat16
