@@ -65,10 +65,26 @@ from apex_tpu.contrib.peer_memory import halo_exchange, PeerHaloExchanger
 from apex_tpu.contrib.bottleneck import Bottleneck, SpatialBottleneck
 from apex_tpu.contrib.conv_bias_relu import ConvBiasReLU
 
+
+class SoftmaxCrossEntropyLoss:
+    """Class-shaped alias (``apex.contrib.xentropy.SoftmaxCrossEntropyLoss``
+    parity): memory-saving CE with label smoothing."""
+
+    def __init__(self, smoothing: float = 0.0, ignore_index: int = -100):
+        self.smoothing = smoothing
+        self.ignore_index = ignore_index
+
+    def __call__(self, logits, labels):
+        return softmax_cross_entropy(
+            logits, labels, smoothing=self.smoothing,
+            ignore_index=self.ignore_index)
+
+
 __all__ = [
     "bottleneck", "conv_bias_relu", "fmha", "focal_loss", "groupbn",
     "index_mul_2d", "peer_memory", "sparsity", "transducer",
     "fused_attention", "fast_layer_norm", "softmax_cross_entropy",
+    "SoftmaxCrossEntropyLoss",
     "SelfMultiheadAttn", "EncdecMultiheadAttn", "clip_grad_norm",
     "sigmoid_focal_loss", "FocalLoss",
     "TransducerJoint", "TransducerLoss", "transducer_joint",

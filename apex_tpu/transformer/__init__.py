@@ -7,6 +7,7 @@ groups; collectives via GSPMD sharding or explicit shard_map mappings.
 """
 
 from apex_tpu.transformer import data
+from apex_tpu.transformer import functional
 from apex_tpu.transformer import log_util
 from apex_tpu.transformer import microbatches
 from apex_tpu.transformer import moe
@@ -42,7 +43,8 @@ from apex_tpu.transformer.enums import (
 )
 
 __all__ = [
-    "parallel_state", "mappings", "random", "data", "log_util",
+    "parallel_state", "mappings", "random", "data", "functional",
+    "log_util",
     "microbatches", "moe", "pipeline_parallel", "broadcast_data",
     "MoEConfig", "MoEMLP",
     "setup_microbatch_calculator", "get_num_microbatches",

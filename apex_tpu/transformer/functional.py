@@ -1,0 +1,63 @@
+"""apex_tpu.transformer.functional — reference-named fused functionals.
+
+Reference: ``apex/transformer/functional/{fused_softmax,fused_rope}.py``
+— the ``FusedScaleMaskSoftmax`` wrapper (picks the scaled / masked /
+upper-triangular CUDA kernel by ``AttnMaskType`` and shape limits) and
+``fused_apply_rotary_pos_emb*``.  Thin aliases over the Pallas ops,
+kept so code written against the reference's import paths reads the
+same; the shape-limit fallback logic dissolves (the Pallas dispatch in
+:mod:`apex_tpu.ops` handles envelopes per call).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.ops.rope import fused_rope, rope_cos_sin
+from apex_tpu.ops.softmax import fused_scale_mask_softmax
+from apex_tpu.transformer.enums import AttnMaskType
+
+__all__ = ["FusedScaleMaskSoftmax", "fused_apply_rotary_pos_emb",
+           "fused_apply_rotary_pos_emb_cached"]
+
+
+class FusedScaleMaskSoftmax:
+    """Callable with the reference's constructor shape.
+
+    ``attn_mask_type``: :class:`AttnMaskType` — ``causal`` applies the
+    in-kernel upper-triangular mask (reference's
+    ``scaled_upper_triang_masked_softmax``); ``padding`` expects an
+    explicit boolean mask (True = masked) at call time.
+    """
+
+    def __init__(self, attn_mask_type: AttnMaskType = AttnMaskType.padding,
+                 scale: Optional[float] = None,
+                 scaled_masked_softmax_fusion: bool = True):
+        self.attn_mask_type = attn_mask_type
+        self.scale = 1.0 if scale is None else float(scale)
+        # fusion flag kept for signature parity; the Pallas/XLA choice
+        # is the ops-level dispatch ("auto")
+        self.fusion = scaled_masked_softmax_fusion
+
+    def __call__(self, x, mask=None):
+        return fused_scale_mask_softmax(
+            x, mask, scale=self.scale,
+            causal=(self.attn_mask_type == AttnMaskType.causal),
+            implementation=None if self.fusion else "xla")
+
+
+def fused_apply_rotary_pos_emb(t, cos=None, sin=None, *, base=10000.0):
+    """RoPE with on-the-fly tables (``fused_apply_rotary_pos_emb``).
+
+    ``t``: (batch, seq, heads, dim).  ``cos``/``sin`` optional
+    precomputed tables (see :func:`fused_apply_rotary_pos_emb_cached`).
+    """
+    if cos is None or sin is None:
+        cos, sin = rope_cos_sin(t.shape[1], t.shape[-1], base=base)
+    return fused_rope(t, cos, sin)
+
+
+def fused_apply_rotary_pos_emb_cached(t, cos, sin):
+    """RoPE with caller-cached cos/sin tables (reference's ``_cached``
+    variant; identical math, tables reused across layers)."""
+    return fused_rope(t, cos, sin)

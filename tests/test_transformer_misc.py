@@ -56,3 +56,44 @@ class TestCommons:
         out = bmodel.apply({"params": bparams},
                            jnp.zeros((2, 8), jnp.int32))
         assert jax.tree.leaves(out)[0].shape[0] == 2
+
+
+class TestFunctionalNamespace:
+    def test_fused_scale_mask_softmax_wrapper(self, rng):
+        from apex_tpu.transformer import functional as F
+        x = jnp.asarray(rng.normal(size=(2, 2, 8, 8)), jnp.float32)
+        sm = F.FusedScaleMaskSoftmax(F.AttnMaskType.causal, scale=0.5)
+        out = sm(x)
+        # causal: last key column masked for first query row
+        assert float(out[0, 0, 0, -1]) == 0.0
+        np.testing.assert_allclose(np.asarray(out.sum(-1)), 1.0,
+                                   rtol=1e-5)
+
+    def test_padding_mask_variant(self, rng):
+        from apex_tpu.transformer import functional as F
+        x = jnp.asarray(rng.normal(size=(1, 1, 4, 4)), jnp.float32)
+        mask = jnp.zeros((1, 1, 4, 4), bool).at[..., -1].set(True)
+        out = F.FusedScaleMaskSoftmax(F.AttnMaskType.padding)(x, mask)
+        assert bool(jnp.all(out[..., -1] == 0.0))
+
+    def test_rope_functional(self, rng):
+        from apex_tpu.transformer import functional as F
+        from apex_tpu.ops.rope import rope_cos_sin, rope_reference
+        t = jnp.asarray(rng.normal(size=(2, 8, 2, 16)), jnp.float32)
+        out = F.fused_apply_rotary_pos_emb(t)
+        cos, sin = rope_cos_sin(8, 16)
+        want = rope_reference(t, cos, sin)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+        cached = F.fused_apply_rotary_pos_emb_cached(t, cos, sin)
+        np.testing.assert_allclose(np.asarray(cached), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_xentropy_class_alias(self, rng):
+        from apex_tpu.contrib import SoftmaxCrossEntropyLoss
+        logits = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+        labels = jnp.asarray([0, 3, 15, 7])
+        ce = SoftmaxCrossEntropyLoss(smoothing=0.1)
+        out = ce(logits, labels)
+        assert out.shape == (4,)
+        assert bool(jnp.all(jnp.isfinite(out)))
