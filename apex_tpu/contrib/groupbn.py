@@ -105,12 +105,20 @@ class GroupBatchNorm2d(nn.Module):
                 # replica stores the same (global-batch) running stats
                 # instead of one arbitrary group's.
                 rmean, rvar = mean, var
+                n_elem = 1
+                for d in range(x.ndim - 1):
+                    n_elem *= x.shape[d]
                 if axis is not None and self.bn_group > 1:
                     rmean = lax.pmean(mean, axis)
                     # law of total variance: E[var] alone drops the
                     # between-group component E[mean²] - E[mean]²
                     rvar = (lax.pmean(var + jnp.square(mean), axis)
                             - jnp.square(rmean))
+                    n_elem *= lax.axis_size(axis)
+                # torch/apex BN stores the *unbiased* variance in
+                # running_var (normalization itself stays biased)
+                if n_elem > 1:
+                    rvar = rvar * (n_elem / (n_elem - 1))
                 ra_mean.value = m * ra_mean.value + (1 - m) * rmean
                 ra_var.value = m * ra_var.value + (1 - m) * rvar
 

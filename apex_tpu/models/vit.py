@@ -26,9 +26,20 @@ __all__ = ["ViTConfig", "ViTModel"]
 
 @dataclasses.dataclass(frozen=True)
 class ViTConfig(TransformerConfig):
+    """Encoder constraints are part of the contract: ``causal`` is
+    always False and ``position_embedding`` always "learned" — passing
+    a conflicting value raises instead of being silently overridden.
+    ``max_seq_len`` is fully determined by the patch grid, so it is not
+    a constructor argument at all (``init=False``); this also keeps
+    ``dataclasses.replace(cfg, patch_size=...)`` working, since replace
+    re-derives it instead of carrying the stale value."""
+
     image_size: int = 224
     patch_size: int = 16
     num_classes: int = 1000
+    causal: bool = False
+    position_embedding: str = "learned"
+    max_seq_len: int = dataclasses.field(init=False, default=-1)
 
     @classmethod
     def tiny(cls, **kw) -> "ViTConfig":
@@ -52,8 +63,14 @@ class ViTConfig(TransformerConfig):
     def __post_init__(self):
         super().__post_init__()
         # encoder: bidirectional attention, learned positions
-        object.__setattr__(self, "causal", False)
-        object.__setattr__(self, "position_embedding", "learned")
+        if self.causal:
+            raise ValueError(
+                "ViTConfig is a bidirectional encoder; causal=True is "
+                "not supported")
+        if self.position_embedding != "learned":
+            raise ValueError(
+                "ViTConfig uses learned position embeddings; got "
+                f"position_embedding={self.position_embedding!r}")
         seq = (self.image_size // self.patch_size) ** 2 + 1
         object.__setattr__(self, "max_seq_len", seq)
 

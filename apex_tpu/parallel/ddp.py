@@ -97,12 +97,18 @@ def all_reduce_mean_grads(grads: Any, axis: str = DATA_AXIS, *,
     - a half dtype (``jnp.bfloat16``/``jnp.float16``): cast before the
       all-reduce, upcast after — the reference DDP's fp16-allreduce
       option (halves ICI bytes);
-    - ``"int8"``: EQuARX-style quantized all-reduce (beyond-reference):
-      grads scaled by the *global* amax to int8, summed in int32 (no
-      overflow for < 2^24 replicas), dequantized — ~4× fewer bytes on
-      the wire at ~1/127 amax quantization error.  Non-finite grads
-      come back NaN so dynamic-loss-scale overflow detection still
-      fires (a plain pmean would likewise propagate them).
+    - ``"int8"``: EQuARX-style quantized all-reduce (beyond-reference)
+      with *genuine* int8 wire traffic: grads are scaled by the global
+      amax to int8, exchanged chunk-wise via an int8 ``all_to_all``
+      (the reduce-scatter leg), accumulated locally in int32 (no
+      overflow for < 2^24 replicas), requantized to int8 against the
+      global partial-sum amax, and ``all_gather``-ed back in int8 —
+      every wire transfer is 1 byte/element, ~4× fewer ICI bytes than
+      an fp32 ring all-reduce, at ~1/127-amax total quantization error
+      (two ½-step stages).  Two extra scalar pmax collectives carry the
+      quantization scales.  Non-finite grads come back NaN so
+      dynamic-loss-scale overflow detection still fires (a plain pmean
+      would likewise propagate them).
     """
     dtype = _normalize_allreduce_dtype(allreduce_dtype)
     reduce = lax.pmean if average else lax.psum
@@ -110,16 +116,46 @@ def all_reduce_mean_grads(grads: Any, axis: str = DATA_AXIS, *,
         return jax.tree.map(lambda g: reduce(g, axis), grads)
     if dtype == "int8":
         n = lax.axis_size(axis)
+        # guard against near-zero amax: 127/amax overflows to +inf for
+        # amax < 127/float32_max (~3.7e-37) and then 0*inf = NaN
+        # poisons zero grads
+        tiny = 127.0 / jnp.finfo(jnp.float32).max
+
+        def inv_scale_for(amax):
+            """(scale, 1/scale) with the near-zero guard; scale == 0
+            means "all-zero payload" and dequantizes to exact 0."""
+            ok = amax > tiny
+            safe = jnp.maximum(amax, tiny)
+            return (jnp.where(ok, 127.0 / safe, 0.0),
+                    jnp.where(ok, safe / 127.0, 0.0))
 
         def q8(g):
             amax = lax.pmax(jnp.max(jnp.abs(g)).astype(jnp.float32),
                             axis)
-            scale = jnp.where(amax > 0, 127.0 / amax, 0.0)
+            scale, inv_scale = inv_scale_for(amax)
             q = jnp.clip(jnp.round(g.astype(jnp.float32) * scale),
-                         -127, 127).astype(jnp.int32)
-            s = lax.psum(q, axis)
-            deq = s.astype(jnp.float32) * jnp.where(
-                scale > 0, 1.0 / scale, 0.0)
+                         -127, 127).astype(jnp.int8)
+            # reduce-scatter leg: int8 on the wire.  all_to_all hands
+            # every device all n replicas of its owned chunk; the sum
+            # happens on-chip in int32 (psum_scatter would accumulate
+            # in the wire dtype and overflow at int8).
+            flat = q.ravel()
+            m = -(-flat.size // n)
+            flat = jnp.pad(flat, (0, m * n - flat.size))
+            mine = lax.all_to_all(flat.reshape(n, m), axis,
+                                  split_axis=0, concat_axis=0,
+                                  tiled=True)
+            s = jnp.sum(mine.astype(jnp.int32), axis=0)
+            # all-gather leg: requantize the int32 partial sums (|s| ≤
+            # 127n) against their global amax so the gather is int8 too
+            s_amax = lax.pmax(jnp.max(jnp.abs(s)).astype(jnp.float32),
+                              axis)
+            rscale, inv_rscale = inv_scale_for(s_amax)
+            r = jnp.clip(jnp.round(s.astype(jnp.float32) * rscale),
+                         -127, 127).astype(jnp.int8)
+            full = lax.all_gather(r, axis, tiled=True)
+            deq = full.astype(jnp.float32) * (inv_rscale * inv_scale)
+            deq = deq[:g.size].reshape(g.shape)
             if average:
                 deq = deq / n
             # inf/nan grads must not be masked to zero: overflow

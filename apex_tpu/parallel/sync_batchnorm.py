@@ -91,8 +91,17 @@ class SyncBatchNorm(nn.Module):
                 x, axes, reduce_dims=reduce_dims)
             if not self.is_initializing():
                 m = self.momentum
+                # torch SyncBatchNorm stores the *unbiased* (Bessel-
+                # corrected) variance in running_var; normalization
+                # itself stays biased
+                n_elem = 1
+                for d in reduce_dims:
+                    n_elem *= x.shape[d]
+                for a in axes:
+                    n_elem *= lax.axis_size(a)
+                rvar = var * (n_elem / (n_elem - 1)) if n_elem > 1 else var
                 ra_mean.value = m * ra_mean.value + (1 - m) * mean
-                ra_var.value = m * ra_var.value + (1 - m) * var
+                ra_var.value = m * ra_var.value + (1 - m) * rvar
 
         y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
         if scale is not None:

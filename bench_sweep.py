@@ -21,13 +21,16 @@ def main():
         "dtype": jnp.bfloat16,
     }
     n_steps = int(os.environ.get("BENCH_STEPS", "20"))
-    state, step, batch, b = bench._build(cfg_kw, "O2", jnp.bfloat16,
-                                         fused=True)
-    dt, loss, finite = bench._measure(state, step, batch, n_steps)
+    k_windows = int(os.environ.get("BENCH_WINDOWS", "2"))
+    state, step, _probes, batch, b = bench._build(
+        cfg_kw, "O2", jnp.bfloat16, fused=True)
+    dt, dts, loss, finite, _ = bench._measure_step(
+        state, step, batch, n_steps, k_windows)
     print(json.dumps({
         "batch": b,
         "remat_policy": cfg_kw["remat_policy"] if cfg_kw["remat"] else None,
         "step_ms": round(dt * 1e3, 2),
+        "window_ms": [round(d * 1e3, 2) for d in dts],
         "samples_per_sec": round(b / dt, 2),
         "finite": finite,
     }))

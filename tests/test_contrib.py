@@ -332,6 +332,20 @@ class TestGroupBNRunningStats:
 
         _, rvar = shard_map(fwd, dp8_mesh, (P("data"),),
                             (P("data"), P()))(x)
-        want = np.asarray(x).var(axis=0)
+        # running_var stores the *unbiased* global-batch estimate
+        # (torch/apex BN parity: normalization is biased, the buffer
+        # is ddof=1)
+        want = np.asarray(x).var(axis=0, ddof=1)
         np.testing.assert_allclose(np.asarray(rvar), want,
                                    rtol=1e-3, atol=1e-3)
+
+    def test_running_var_unbiased_local(self, rng):
+        x = jnp.asarray(rng.normal(size=(8, 3)).astype(np.float32))
+        gbn = groupbn.GroupBatchNorm2d(
+            bn_group=1, axis_name=None, use_running_average=False,
+            momentum=0.0)
+        v = gbn.init(jax.random.PRNGKey(0), x)
+        _, mut = gbn.apply(v, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["var"]),
+            np.asarray(x).var(axis=0, ddof=1), rtol=1e-5, atol=1e-6)

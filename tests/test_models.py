@@ -218,6 +218,22 @@ class TestViT:
         out1, out2 = m.apply(v, x), m.apply(v, x2)
         assert not np.allclose(np.asarray(out1), np.asarray(out2))
 
+    def test_config_conflicts_raise(self):
+        # forced encoder fields must reject explicit conflicting values
+        # instead of silently overriding them
+        from apex_tpu.models import ViTConfig
+        with pytest.raises(ValueError, match="causal"):
+            ViTConfig.tiny(causal=True)
+        with pytest.raises(ValueError, match="position_embedding"):
+            ViTConfig.tiny(position_embedding="rope")
+        # max_seq_len is derived (init=False): not a constructor arg
+        with pytest.raises(TypeError, match="max_seq_len"):
+            ViTConfig.tiny(max_seq_len=99)
+        # dataclasses.replace re-derives it from the new patch grid
+        import dataclasses as dc
+        cfg = dc.replace(ViTConfig.tiny(), patch_size=16)
+        assert cfg.max_seq_len == (32 // 16) ** 2 + 1
+
 
 class TestBertMlmPositions:
     def test_gathered_logits_match_full(self, rng):

@@ -106,6 +106,11 @@ class TestSyncBatchNorm:
         mean, var = shard_map(fwd, dp_mesh, (P("data"),), (P(), P()))(x)
         want = 0.5 * 0.0 + 0.5 * np.mean(np.asarray(x), axis=0)
         np.testing.assert_allclose(np.asarray(mean), want, rtol=1e-4)
+        # running_var stores the unbiased (ddof=1) estimate — torch
+        # SyncBatchNorm parity
+        want_var = 0.5 * 1.0 + 0.5 * np.var(np.asarray(x), axis=0, ddof=1)
+        np.testing.assert_allclose(np.asarray(var), want_var,
+                                   rtol=1e-4, atol=1e-5)
 
     def test_eval_mode_uses_running(self, rng):
         x = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
@@ -249,6 +254,34 @@ class TestCompressedAllreduce:
         with pytest.raises(ValueError, match="allreduce_dtype"):
             apx_parallel.all_reduce_mean_grads(
                 {"g": g}, allreduce_dtype=jnp.int32)
+
+    def test_int8_subnormal_amax_no_nan(self, dp_mesh):
+        # amax in (0, ~3.7e-37): an unguarded 127/amax overflows to
+        # +inf and 0*inf = NaN would poison zero grad elements.
+        # 1e-37 > finfo.tiny, so a guard at finfo.tiny misses it
+        g = jnp.full((16, 4), 1e-37, jnp.float32).at[0, 0].set(0.0)
+        f = shard_map(
+            lambda gs: apx_parallel.all_reduce_mean_grads(
+                {"g": gs}, allreduce_dtype="int8")["g"],
+            dp_mesh, (P("data"),), P("data"))
+        out = np.asarray(f(g))
+        assert np.isfinite(out).all(), \
+            "subnormal amax must not produce NaN gradients"
+
+    def test_int8_wire_dtype_is_int8(self, dp_mesh, rng):
+        # the collectives that move O(n) payload must run on int8
+        # operands — that IS the compression claim
+        g = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+        f = jax.jit(shard_map(
+            lambda gs: apx_parallel.all_reduce_mean_grads(
+                {"g": gs}, allreduce_dtype="int8")["g"],
+            dp_mesh, (P("data"),), P("data")))
+        hlo = f.lower(g).as_text()  # StableHLO text
+        for op in ("stablehlo.all_to_all", "stablehlo.all_gather"):
+            ops = [l for l in hlo.splitlines() if op in l]
+            assert ops, f"expected a {op} in the lowered module"
+            assert all("xi8>" in l for l in ops), \
+                f"{op} payload must be int8 on the wire:\n" + "\n".join(ops)
 
     def test_int8_propagates_nonfinite(self, dp_mesh):
         g = jnp.full((16, 4), jnp.inf, jnp.float32)
