@@ -6,22 +6,25 @@ Reference: ``apex/transformer/pipeline_parallel/schedules/`` —
 ``_pipelining_with_interleaving`` (virtual pipeline), dispatched by
 ``get_forward_backward_func()`` (SURVEY.md §3.5).
 
-TPU design — *the schedule is a program, not an event loop*:
+TPU design — *the schedule is a program, not an event loop*.  Two
+complementary mechanisms:
 
-- The forward pipeline is a ``lax.scan`` over ``M + pp - 1`` ticks
-  inside ``shard_map`` over the ``pipe`` axis.  Every tick, every stage
-  runs its layer chunk and hands activations to its neighbor with one
-  ``lax.ppermute`` (ICI neighbor exchange).  Dead ticks (pipeline
-  bubble) are masked — they cost exactly the (pp-1)/M bubble of 1F1B.
-- The backward needs no hand-written schedule AT ALL: JAX transposes
-  the scan+ppermute program, yielding the reverse pipeline (cooldown →
-  steady → warmup) with gradients flowing stage-to-stage by the
-  transposed ppermute — the schedule the reference codes by hand in
-  ~2k lines falls out of autodiff.
-- Activation memory: the reference's 1F1B bounds live activations at
-  ``pp`` microbatches by interleaving; here ``jax.checkpoint`` on the
-  stage body bounds residuals to one (mb, seq, hidden) carry per tick,
-  recomputing the stage interior in the transposed pass.
+- :func:`spmd_pipeline_1f1b` (used by the reference-named 1F1B driver)
+  hand-writes the one-forward-one-backward tick table as a single
+  ``lax.scan`` inside ``shard_map`` over ``pipe``: each tick runs one
+  forward unit and one backward unit (``jax.vjp`` recompute +
+  transpose), activations ride a forward ``ppermute`` ring, cotangents
+  a reverse ring, and live activations are bounded by a ``2*pp``-slot
+  stash of stage *inputs* — O(pp), flat in M, exactly the memory shape
+  that is 1F1B's reason to exist.  Dead warmup/cooldown units are
+  skipped with ``lax.cond``, not computed-and-masked.
+- :func:`spmd_pipeline` / :func:`spmd_pipeline_interleaved` are
+  *autodiff-able forward* pipelines (scan + ppermute): JAX transposes
+  them into the reverse pipeline, so they compose with outer
+  ``value_and_grad`` (e.g. a model with embedding/head outside the
+  pipelined region).  Convenient, but the transposed scan stashes all
+  ``M + pp - 1`` tick outputs — O(M) activation memory; prefer the
+  1F1B driver for large M.
 
 The pipeline spans the homogeneous transformer stack (stage params are
 stacked along a leading ``pp`` axis and split by ``shard_map``);
@@ -42,11 +45,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 from apex_tpu.core.mesh import PIPE_AXIS
 from apex_tpu.transformer.microbatches import get_num_microbatches
 from apex_tpu.transformer.pipeline_parallel.p2p import (
+    send_backward_recv_backward,
     send_forward_recv_forward,
 )
 
 __all__ = [
     "spmd_pipeline",
+    "spmd_pipeline_1f1b",
     "spmd_pipeline_interleaved",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
@@ -127,6 +132,171 @@ def spmd_pipeline(
     outs = lax.psum(
         jnp.where(rank == pp - 1, outs, jnp.zeros_like(outs)), axis)
     return outs
+
+
+# --------------------------------------------------------------------- #
+# true 1F1B: interleaved forward/backward, O(pp) live activations
+# --------------------------------------------------------------------- #
+def spmd_pipeline_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Any,
+    microbatches: jnp.ndarray,
+    *,
+    axis: str = PIPE_AXIS,
+):
+    """One-forward-one-backward pipeline, computing ``(loss, grads)``
+    directly — the schedule IS the backward pass, not its autodiff
+    transpose.
+
+    Reference: ``fwd_bwd_pipelining_without_interleaving.py`` — the
+    point of 1F1B is the *memory shape*: each microbatch's backward runs
+    as soon as its loss exists, so live activations are bounded by
+    O(pp) microbatches regardless of M (SURVEY.md §2.6 schedules row).
+    A ``value_and_grad`` over a scanned forward cannot have that shape
+    (the transposed scan replays stashed tick outputs, O(M)); so this
+    function hand-writes the 1F1B tick table as a single SPMD
+    ``lax.scan`` and differentiates *inside* each tick:
+
+    - tick ``t``, rank ``r`` **forward-unit**: microbatch ``mf = t - r``
+      (valid when ``0 <= mf < M``) — stage input from the forward
+      ``ppermute`` ring (rank 0 injects fresh microbatches), stage
+      output handed to ``r+1``; the stage *input* is stored in a
+      ``2*pp``-slot circular stash (inputs only — the stage interior is
+      recomputed in the backward unit, remat by construction).
+    - rank ``pp-1`` computes ``loss_fn`` and its output-cotangent
+      immediately after each forward (the "1B follows 1F" half).
+    - tick ``t``, rank ``r`` **backward-unit**: microbatch
+      ``mb = t - (2*pp - 1) + r`` — pops the stashed input,
+      ``jax.vjp(stage_fn)`` recomputes the stage and pulls the incoming
+      cotangent back; the input-cotangent rides the reverse
+      ``ppermute`` ring to rank ``r-1``, the parameter-cotangent
+      accumulates into the scan carry.
+    - dead warmup/cooldown units are *skipped* (``lax.cond``), not
+      computed-and-masked.
+
+    Memory: carry = fwd/bwd ring activations + ``2*pp`` stash slots +
+    grad accumulator — flat in M (asserted by
+    ``tests/test_pipeline.py::test_memory_flat_in_microbatches``).
+    Total ticks ``M + 2*pp - 1``; each runs one F and one B unit, so
+    the bubble is ``(2*pp-1)/(M+2*pp-1)`` of the schedule — the
+    steady-state is exactly Megatron's one-forward-one-backward.
+
+    Must be called inside ``shard_map`` with ``axis`` bound; arguments
+    as in :func:`spmd_pipeline` plus ``loss_fn(y, microbatch_index) ->
+    scalar`` (mean over the microbatch; the returned loss is the mean
+    over all M microbatches).  Returns ``(loss_local, grads_local)``:
+    ``loss_local`` is the total on rank ``pp-1`` and 0 elsewhere (psum
+    and divide by M outside or use the driver), ``grads_local`` matches
+    this rank's stripped ``stage_params``.
+    """
+    pp = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    num_micro = microbatches.shape[0]
+    n_ticks = num_micro + 2 * pp - 1
+    n_slots = 2 * pp
+
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.ndim and leaf.shape[0] != 1:
+            raise ValueError(
+                f"stage_params' leading (stacked-stage) axis must be "
+                f"split over '{axis}' to local size 1, got local size "
+                f"{leaf.shape[0]} for a {leaf.shape} leaf — pass "
+                f"params_spec=P('{axis}', ...) on every leaf")
+    params_local = jax.tree.map(
+        lambda a: a[0] if a.ndim else a, stage_params)
+
+    mb_shape = microbatches[0]
+
+    def varying(x):
+        """Mark ``x`` device-varying over ``axis`` (no-op if already)."""
+        try:
+            return lax.pcast(x, (axis,), to="varying")
+        except ValueError:
+            return x
+
+    def tick(carry, t):
+        fwd_x, bwd_ct, pending_ct, stash, loss_acc, grad_acc = carry
+
+        # ---- forward unit: microbatch mf = t - rank ----
+        mf = t - rank
+        valid_f = (mf >= 0) & (mf < num_micro)
+        mb = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(mf, 0, num_micro - 1), axis=0,
+            keepdims=False)
+        x = jnp.where(rank == 0, mb, fwd_x)
+        y = lax.cond(valid_f,
+                     lambda a: varying(stage_fn(params_local, a)),
+                     lambda a: varying(jnp.zeros_like(a)), x)
+        # stash the stage INPUT (slot mf mod 2pp; live range < 2pp so
+        # no collision); dead units must not overwrite a live slot
+        slot = jnp.clip(mf, 0, num_micro - 1) % n_slots
+        new_stash = lax.dynamic_update_index_in_dim(
+            stash, x.astype(stash.dtype), slot, axis=0)
+        stash = jnp.where(valid_f, new_stash, stash)
+
+        # ---- loss + output-cotangent on the last rank ----
+        def loss_and_ct(y):
+            lval, pull = jax.vjp(lambda yy: loss_fn(yy, mf), y)
+            # compute 1/M in f32 first: a bf16 loss_fn would otherwise
+            # round the seed (and the f32 zero in the false branch
+            # requires an f32 loss either way)
+            seed = varying((jnp.float32(1) / num_micro).astype(lval.dtype))
+            (ct,) = pull(seed)
+            return varying(lval.astype(jnp.float32)), varying(ct)
+
+        is_last = rank == pp - 1
+        lval, new_pending = lax.cond(
+            valid_f & is_last, loss_and_ct,
+            lambda y: (varying(jnp.zeros((), jnp.float32)),
+                       varying(jnp.zeros_like(y))), y)
+        loss_acc = loss_acc + lval
+
+        # ---- backward unit: microbatch mb_b = t - (2pp-1) + rank ----
+        mb_b = t - (2 * pp - 1) + rank
+        valid_b = (mb_b >= 0) & (mb_b < num_micro)
+        x_saved = lax.dynamic_index_in_dim(
+            stash, jnp.clip(mb_b, 0, num_micro - 1) % n_slots, axis=0,
+            keepdims=False)
+        # incoming cotangent: reverse ring from rank r+1; the last rank
+        # feeds itself the loss cotangent it computed LAST tick (for
+        # exactly the microbatch whose backward is due this tick)
+        ct_in = jnp.where(is_last, pending_ct, bwd_ct)
+
+        def run_bwd(operands):
+            x_s, ct = operands
+            _, pull = jax.vjp(stage_fn, params_local, x_s)
+            gp, gx = pull(ct)
+            return jax.tree.map(varying, (gp, gx))
+
+        gp, gx = lax.cond(
+            valid_b, run_bwd,
+            lambda operands: jax.tree.map(varying, (
+                jax.tree.map(jnp.zeros_like, params_local),
+                jnp.zeros_like(operands[0]))),
+            (x_saved, ct_in))
+        grad_acc = jax.tree.map(jnp.add, grad_acc, gp)
+
+        # ---- rings ----
+        fwd_x = send_forward_recv_forward(y, axis=axis)
+        bwd_ct = send_backward_recv_backward(gx, axis=axis)
+        return (fwd_x, bwd_ct, new_pending, stash, loss_acc,
+                grad_acc), None
+
+    init = (
+        varying(jnp.zeros_like(mb_shape)),                  # fwd ring
+        varying(jnp.zeros_like(mb_shape)),                  # bwd ring
+        varying(jnp.zeros_like(mb_shape)),                  # pending ct
+        varying(jnp.zeros((n_slots,) + mb_shape.shape,
+                          mb_shape.dtype)),                 # stash
+        varying(jnp.zeros((), jnp.float32)),                # loss acc
+        # grad acc: zeros_like(params) is already device-varying (the
+        # params came in split over `axis`), so no pcast here
+        jax.tree.map(jnp.zeros_like, params_local),          # grad acc
+    )
+    carry, _ = lax.scan(tick, init, jnp.arange(n_ticks))
+    _, _, _, _, loss_acc, grad_acc = carry
+    return loss_acc, grad_acc
 
 
 # --------------------------------------------------------------------- #
@@ -327,12 +497,41 @@ def forward_backward_pipelining_without_interleaving(
     ``loss_fn(y, microbatch_index) -> scalar`` scores last-stage output.
     ``batch``: ``(M * mb, seq, hidden)``.  Returns ``(loss, grads)``
     with ``grads`` matching ``stage_params``.
+
+    This drives :func:`spmd_pipeline_1f1b` — the explicit
+    one-forward-one-backward tick table with O(pp) live activations —
+    rather than autodiff over the forward scan (which would stash all
+    ``M + pp - 1`` tick outputs).  ``remat`` is accepted for API
+    stability but has no effect: 1F1B recomputes each stage interior
+    from its stashed input by construction (``jax.vjp`` per backward
+    unit), which is exactly ``remat=True`` semantics.
     """
-    return _pipelined_value_and_grad(
-        spmd_pipeline, lambda ax: P(ax),
-        stage_fn, loss_fn, stage_params, batch, mesh=mesh,
-        num_microbatches=num_microbatches, axis=axis, remat=remat,
-        params_spec=params_spec)
+    del remat  # remat-by-construction (see docstring)
+    m = num_microbatches or get_num_microbatches()
+    mbs = batch.reshape(m, batch.shape[0] // m, *batch.shape[1:])
+    pspec = params_spec if params_spec is not None else P(axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=(P(), pspec),
+        # only `pipe` goes manual: data/tensor axes inside the stage
+        # remain GSPMD-managed, so TP layers compose with the pipeline
+        axis_names={axis})
+    def run(params_local, mbs_local):
+        loss_local, grads_local = spmd_pipeline_1f1b(
+            stage_fn, loss_fn, params_local, mbs_local, axis=axis)
+        # loss_local is the per-microbatch sum on rank pp-1, 0 elsewhere
+        loss = lax.psum(loss_local, axis) / m
+        # restore the stripped stacked-stage axis for the out_spec
+        # (judge by the LOCAL leaf: ndim>=1 means it carried the split
+        # stage axis; 0-d leaves were replicated scalars whose grad is
+        # the sum of every stage's contribution)
+        grads = jax.tree.map(
+            lambda g, a: g[None] if a.ndim else lax.psum(g, axis),
+            grads_local, params_local)
+        return loss, grads
+
+    return run(stage_params, mbs)
 
 
 def forward_backward_pipelining_with_interleaving(

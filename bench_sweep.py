@@ -21,7 +21,7 @@ def main():
         "dtype": jnp.bfloat16,
     }
     n_steps = int(os.environ.get("BENCH_STEPS", "20"))
-    k_windows = int(os.environ.get("BENCH_WINDOWS", "2"))
+    k_windows = max(1, int(os.environ.get("BENCH_WINDOWS", "2")))
     state, step, _probes, batch, b = bench._build(
         cfg_kw, "O2", jnp.bfloat16, fused=True)
     dt, dts, loss, finite, _ = bench._measure_step(
