@@ -108,20 +108,24 @@ def o1_intercept(half_dtype=jnp.bfloat16):
         kwargs = _cast_tree(kwargs, target)
         # casting inputs is not enough: flax modules with dtype=None
         # promote with their (fp32) params, so the GEMM would run fp32.
-        # Setting the module's compute dtype casts the *weights* per-op
-        # too — exactly the reference's O1 semantics (fp32 masters, half
-        # compute).  Restore afterwards: for bind()/setup-created bound
-        # modules the instance outlives this call, and the override must
-        # not leak past the amp scope.
+        # The module's compute dtype must be the target so the *weights*
+        # are cast per-op too — exactly the reference's O1 semantics
+        # (fp32 masters, half compute).  Rather than mutating the bound
+        # instance (shared state across concurrent traces, against
+        # flax's immutability contract), run the call on a clone bound
+        # to the same scope: same variables, overridden dtype, original
+        # instance untouched.  The parent scope is ``rewound()`` — same
+        # variable store, fresh name reservations — because the original
+        # instance's setup has already reserved its param names by the
+        # time the interceptor fires, and a second instance creating the
+        # same names in the un-rewound scope is a NameInUseError.
+        # Re-entry is safe — the clone's dtype is no longer None, so it
+        # takes the plain next_fn path below.
         module = context.module
-        override = getattr(module, "dtype", "__missing__") is None
-        if override:
-            object.__setattr__(module, "dtype", target)
-        try:
-            return next_fn(*args, **kwargs)
-        finally:
-            if override:
-                object.__setattr__(module, "dtype", None)
+        if getattr(module, "dtype", "__missing__") is None and module.scope is not None:
+            clone = module.clone(dtype=target, parent=module.scope.rewound())
+            return getattr(clone, context.method_name)(*args, **kwargs)
+        return next_fn(*args, **kwargs)
 
     with nn.intercept_methods(interceptor):
         yield

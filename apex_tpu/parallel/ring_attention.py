@@ -20,11 +20,19 @@ Algorithm (Liu et al., Ring Attention; flash-style accumulation):
   ``dk``/``dv`` accumulate on buffers that rotate *with* their KV chunk,
   arriving back at the home rank after the full cycle — the transpose
   of the forward's communication pattern, made explicit.
+- the ring is a ``lax.scan`` over the ``cp`` ticks, so the compiled HLO
+  is O(1) in ``cp`` (one rotation's program, iterated) — a Python
+  unroll would compile O(cp) copies and stall the pipeline at cp=32+.
 - causal: chunk-level masks from global positions
-  (``rank*s_local + iota``). Under SPMD every rank executes every step,
-  so fully-masked chunk products are computed-then-discarded — the
-  known ~2x causal overhead of plain ring attention; the memory win is
-  what context parallelism is for.
+  (``rank*s_local + iota``). Under SPMD every rank executes every tick,
+  but ticks whose KV chunk is entirely in the masked future skip the
+  chunk math through ``lax.cond`` (the rotation still runs — the ring
+  must keep turning), cutting the classic ~2x causal overhead of plain
+  ring attention to roughly the live-chunk fraction.
+- ``remat=True``: the forward saves only (q, k, v); the backward
+  re-runs the forward accumulation ring to recover (o, lse) instead of
+  storing them per layer — O(S/cp · h · d) saved per layer, the right
+  trade for long-context stacks where CP exists to bound memory.
 - GQA: grouped einsums throughout — KV heads are never materialized to
   ``num_heads`` (same policy as the Pallas kernels in
   :mod:`apex_tpu.ops.attention`); the group dim sums away naturally in
@@ -75,10 +83,20 @@ def _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk, offset):
     return jnp.where(dead[None, None, None], _NEG_INF, s)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _chunk_fully_dead(causal, rank, src, sq, sk, offset):
+    """True iff every (q, k) pair in this (rank, src) chunk product is
+    causally masked — the whole KV chunk lies in the masked future of
+    the local Q block.  Device-varying scalar; drives ``lax.cond``."""
+    if not causal:
+        return jnp.bool_(False)
+    return src * sk > rank * sq + (sq - 1) + offset
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def ring_attention(q, k, v, axis: str = CONTEXT_AXIS,
                    causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   remat: bool = False):
     """Exact attention over a sequence sharded on mesh axis ``axis``.
 
     Must be called inside ``shard_map`` (or ``jit`` with the axis
@@ -87,59 +105,97 @@ def ring_attention(q, k, v, axis: str = CONTEXT_AXIS,
     output shard ``(b, s_local, h, d)``. Semantics (incl. GQA and
     dead-row zeros) match :func:`apex_tpu.ops.attention_reference` on
     the gathered sequence.
+
+    ``remat=True`` saves only (q, k, v) for the backward, which re-runs
+    the forward ring to recover (o, lse) — one extra ring pass of
+    compute for O(s_local·h·d) less residual memory per call.
     """
-    o, _ = _ring_fwd(q, k, v, axis, causal, scale)
+    o, _ = _ring_fwd(q, k, v, axis, causal, scale, remat)
     return o
 
 
-def _ring_fwd(q, k, v, axis, causal, scale):
+def _fwd_accum(q, k, v, axis, causal, scale):
+    """The forward ring: returns (o fp32 grouped (b,sq,hk,g,d), lse)."""
     cp = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
+    g = h // hk
+
+    qg = q.astype(jnp.float32).reshape(b, sq, hk, g, d)
+    offset = cp * (sk - sq)                          # Sk_glob - Sq_glob
+
+    def tick(carry, t):
+        m, l, acc, kc, vc = carry
+        src = (rank - t) % cp
+
+        def live(m, l, acc):
+            s = _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk,
+                              offset)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            if causal:
+                p = jnp.where(s < 0.5 * _NEG_INF, 0.0, p)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + p.sum(axis=-1)
+            acc_new = (acc * corr.transpose(0, 3, 1, 2)[..., None]
+                       + jnp.einsum("bhgqs,bshd->bqhgd", p,
+                                    vc.astype(jnp.float32),
+                                    preferred_element_type=jnp.float32))
+            return m_new, l_new, acc_new
+
+        if causal:
+            m, l, acc = lax.cond(
+                _chunk_fully_dead(causal, rank, src, sq, sk, offset),
+                lambda m, l, acc: (m, l, acc), live, m, l, acc)
+        else:
+            m, l, acc = live(m, l, acc)
+        kc, vc = _rotate((kc, vc), axis)
+        return (m, l, acc, kc, vc), None
+
+    # the accumulators are device-varying (each rank's differ), so the
+    # cond/scan carry types must line up with the axis-varying chunk
+    # products under shard_map's vma checking; a q-derived zero carries
+    # exactly q's varying-axes set (ring axis, plus e.g. a data axis
+    # when DP composes)
+    zero = qg[0, 0, 0, 0, 0] * 0.0
+    m0 = jnp.full((b, hk, g, sq), _NEG_INF, jnp.float32) + zero
+    l0 = jnp.zeros((b, hk, g, sq), jnp.float32) + zero
+    acc0 = jnp.zeros((b, sq, hk, g, d), jnp.float32) + zero
+    (m, l, acc, _, _), _ = lax.scan(
+        tick, (m0, l0, acc0, k, v), jnp.arange(cp))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    og = acc / l_safe.transpose(0, 3, 1, 2)[..., None]
+    lse = m + jnp.log(l_safe)                        # dead rows: ~-inf
+    return og, lse
+
+
+def _ring_fwd(q, k, v, axis, causal, scale, remat):
+    b, sq, h, d = q.shape
+    hk = k.shape[2]
     if h % hk:
         raise ValueError(
             f"num_kv_heads ({hk}) must divide num_heads ({h})")
-    g = h // hk
     scale = (d ** -0.5) if scale is None else float(scale)
-
-    qg = q.astype(jnp.float32).reshape(b, sq, hk, g, d)
-    m = jnp.full((b, hk, g, sq), _NEG_INF, jnp.float32)
-    l = jnp.zeros((b, hk, g, sq), jnp.float32)
-    acc = jnp.zeros((b, sq, hk, g, d), jnp.float32)
-    offset = cp * (sk - sq)                          # Sk_glob - Sq_glob
-    kv = (k, v)
-    for t in range(cp):
-        kc, vc = kv
-        src = (rank - t) % cp
-        s = _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk,
-                          offset)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        if causal:
-            p = jnp.where(s < 0.5 * _NEG_INF, 0.0, p)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        acc = acc * corr.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
-            "bhgqs,bshd->bqhgd", p, vc.astype(jnp.float32),
-            preferred_element_type=jnp.float32)
-        m = m_new
-        kv = _rotate(kv, axis)
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o = (acc / l_safe.transpose(0, 3, 1, 2)[..., None]
-         ).reshape(b, sq, h, d).astype(q.dtype)
-    lse = m + jnp.log(l_safe)                        # dead rows: ~-inf
-    return o, (q, k, v, o, lse)
+    og, lse = _fwd_accum(q, k, v, axis, causal, scale)
+    o = og.reshape(b, sq, h, d).astype(q.dtype)
+    res = (q, k, v) if remat else (q, k, v, o, lse)
+    return o, res
 
 
-def _ring_bwd(axis, causal, scale, res, do):
-    q, k, v, o, lse = res
+def _ring_bwd(axis, causal, scale, remat, res, do):
+    scale = (res[0].shape[-1] ** -0.5) if scale is None else float(scale)
+    if remat:
+        q, k, v = res
+        og, lse = _fwd_accum(q, k, v, axis, causal, scale)
+        o = og.reshape(q.shape).astype(q.dtype)
+    else:
+        q, k, v, o, lse = res
     cp = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
     g = h // hk
-    scale = (d ** -0.5) if scale is None else float(scale)
 
     qg = q.astype(jnp.float32).reshape(b, sq, hk, g, d)
     dog = do.astype(jnp.float32).reshape(b, sq, hk, g, d)
@@ -149,35 +205,48 @@ def _ring_bwd(axis, causal, scale, res, do):
     delta = delta.transpose(0, 2, 3, 1)[..., None]   # (b, hk, g, sq, 1)
     lse_col = lse[..., None]                         # (b, hk, g, sq, 1)
 
-    dq = jnp.zeros((b, sq, hk, g, d), jnp.float32)
     offset = cp * (sk - sq)                          # Sk_glob - Sq_glob
-    ring = (k, v,
-            jnp.zeros((b, sk, hk, d), jnp.float32),
-            jnp.zeros((b, sk, hk, d), jnp.float32))
-    for t in range(cp):
-        kc, vc, dkc, dvc = ring
+
+    def tick(carry, t):
+        dq, kc, vc, dkc, dvc = carry
         src = (rank - t) % cp
-        s = _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk,
-                          offset)
-        p = jnp.exp(s - lse_col)
-        # dead positions (incl. fully-dead rows, where lse ~ -inf and
-        # s - lse ~ 0) contribute nothing
-        p = jnp.where(s < 0.5 * _NEG_INF, 0.0, p) if causal else p
-        # the group dim sums away: dv/dk land directly on hk heads
-        dv_c = jnp.einsum("bhgqs,bqhgd->bshd", p, dog,
-                          preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bqhgd,bshd->bhgqs", dog,
-                        vc.astype(jnp.float32),
-                        preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dq = dq + jnp.einsum("bhgqs,bshd->bqhgd", ds,
-                             kc.astype(jnp.float32),
-                             preferred_element_type=jnp.float32) * scale
-        dk_c = jnp.einsum("bhgqs,bqhgd->bshd", ds, qg,
-                          preferred_element_type=jnp.float32) * scale
-        ring = _rotate((kc, vc, dkc + dk_c, dvc + dv_c), axis)
+
+        def live(dq, dkc, dvc):
+            s = _chunk_scores(qg, kc, scale, causal, rank, src, sq, sk,
+                              offset)
+            p = jnp.exp(s - lse_col)
+            # dead positions (incl. fully-dead rows, where lse ~ -inf
+            # and s - lse ~ 0) contribute nothing
+            p = jnp.where(s < 0.5 * _NEG_INF, 0.0, p) if causal else p
+            # the group dim sums away: dv/dk land directly on hk heads
+            dv_c = jnp.einsum("bhgqs,bqhgd->bshd", p, dog,
+                              preferred_element_type=jnp.float32)
+            dp = jnp.einsum("bqhgd,bshd->bhgqs", dog,
+                            vc.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+            ds = p * (dp - delta)
+            dq_new = dq + jnp.einsum(
+                "bhgqs,bshd->bqhgd", ds, kc.astype(jnp.float32),
+                preferred_element_type=jnp.float32) * scale
+            dk_c = jnp.einsum("bhgqs,bqhgd->bshd", ds, qg,
+                              preferred_element_type=jnp.float32) * scale
+            return dq_new, dkc + dk_c, dvc + dv_c
+
+        if causal:
+            dq, dkc, dvc = lax.cond(
+                _chunk_fully_dead(causal, rank, src, sq, sk, offset),
+                lambda dq, dkc, dvc: (dq, dkc, dvc), live, dq, dkc, dvc)
+        else:
+            dq, dkc, dvc = live(dq, dkc, dvc)
+        kc, vc, dkc, dvc = _rotate((kc, vc, dkc, dvc), axis)
         # cp rotations total: dk/dv buffers arrive back home
-    _, _, dk, dv = ring
+        return (dq, kc, vc, dkc, dvc), None
+
+    zero = qg[0, 0, 0, 0, 0] * 0.0
+    dq0 = jnp.zeros((b, sq, hk, g, d), jnp.float32) + zero
+    zkv = jnp.zeros((b, sk, hk, d), jnp.float32) + zero
+    (dq, _, _, dk, dv), _ = lax.scan(
+        tick, (dq0, k, v, zkv, zkv), jnp.arange(cp))
     return (dq.reshape(b, sq, h, d).astype(q.dtype),
             dk.astype(k.dtype), dv.astype(v.dtype))
 
@@ -189,6 +258,7 @@ def ring_self_attention(q, k, v, *, mesh: Mesh,
                         axis: str = CONTEXT_AXIS,
                         causal: bool = False,
                         scale: Optional[float] = None,
+                        remat: bool = False,
                         batch_spec: Optional[Tuple] = None):
     """Convenience wrapper: global (b, S, h, d) arrays in, shard_map'd
     ring attention over ``axis`` inside.
@@ -203,6 +273,6 @@ def ring_self_attention(q, k, v, *, mesh: Mesh,
         jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, axis_names={axis} | ({bs} if bs else set()))
     def run(ql, kl, vl):
-        return ring_attention(ql, kl, vl, axis, causal, scale)
+        return ring_attention(ql, kl, vl, axis, causal, scale, remat)
 
     return run(q, k, v)

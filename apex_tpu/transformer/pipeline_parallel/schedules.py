@@ -144,6 +144,7 @@ def spmd_pipeline_1f1b(
     microbatches: jnp.ndarray,
     *,
     axis: str = PIPE_AXIS,
+    microbatches_distributed: bool = False,
 ):
     """One-forward-one-backward pipeline, computing ``(loss, grads)``
     directly — the schedule IS the backward pass, not its autodiff
@@ -189,10 +190,26 @@ def spmd_pipeline_1f1b(
     ``loss_local`` is the total on rank ``pp-1`` and 0 elsewhere (psum
     and divide by M outside or use the driver), ``grads_local`` matches
     this rank's stripped ``stage_params``.
+
+    ``microbatches_distributed=True``: ``microbatches`` is the *local*
+    cyclic shard ``(M/pp, mb, ...)`` — rank ``r`` holds global
+    microbatches ``r::pp`` — instead of the full replicated ``(M, ...)``
+    tensor, so per-rank input memory is O(M/pp) not O(M).  A feed ring
+    streams each microbatch to rank 0 just in time: every ``pp`` ticks
+    all ranks inject their next local microbatch into a one-slot feed
+    buffer that shifts one hop toward rank 0 per tick — the item rank
+    ``j`` injects at tick ``q*pp`` arrives at rank 0 exactly at tick
+    ``q*pp + j``, which is when microbatch ``q*pp + j`` enters the
+    pipeline.  One extra single-microbatch ``ppermute`` per tick,
+    overlapped with the stage compute like the main rings.
     """
     pp = lax.axis_size(axis)
     rank = lax.axis_index(axis)
-    num_micro = microbatches.shape[0]
+    if microbatches_distributed:
+        local_n = microbatches.shape[0]
+        num_micro = local_n * pp
+    else:
+        num_micro = microbatches.shape[0]
     n_ticks = num_micro + 2 * pp - 1
     n_slots = 2 * pp
 
@@ -216,14 +233,19 @@ def spmd_pipeline_1f1b(
             return x
 
     def tick(carry, t):
-        fwd_x, bwd_ct, pending_ct, stash, loss_acc, grad_acc = carry
+        fwd_x, bwd_ct, pending_ct, feed, stash, loss_acc, grad_acc = carry
 
         # ---- forward unit: microbatch mf = t - rank ----
         mf = t - rank
         valid_f = (mf >= 0) & (mf < num_micro)
-        mb = lax.dynamic_index_in_dim(
-            microbatches, jnp.clip(mf, 0, num_micro - 1), axis=0,
-            keepdims=False)
+        if microbatches_distributed:
+            # feed-ring invariant: at the start of tick t, rank 0's
+            # feed buffer holds microbatch t (see docstring)
+            mb = feed
+        else:
+            mb = lax.dynamic_index_in_dim(
+                microbatches, jnp.clip(mf, 0, num_micro - 1), axis=0,
+                keepdims=False)
         x = jnp.where(rank == 0, mb, fwd_x)
         y = lax.cond(valid_f,
                      lambda a: varying(stage_fn(params_local, a)),
@@ -280,13 +302,27 @@ def spmd_pipeline_1f1b(
         # ---- rings ----
         fwd_x = send_forward_recv_forward(y, axis=axis)
         bwd_ct = send_backward_recv_backward(gx, axis=axis)
-        return (fwd_x, bwd_ct, new_pending, stash, loss_acc,
+        if microbatches_distributed:
+            # re-establish the feed invariant for tick t+1: inject the
+            # next local microbatch every pp ticks, else shift the feed
+            # one hop toward rank 0
+            nxt_q = (t + 1) // pp
+            local_next = lax.dynamic_index_in_dim(
+                microbatches, jnp.clip(nxt_q, 0, local_n - 1), axis=0,
+                keepdims=False)
+            shifted = lax.ppermute(
+                feed, axis, [(i, (i - 1) % pp) for i in range(pp)])
+            feed = jnp.where((t + 1) % pp == 0, local_next, shifted)
+        return (fwd_x, bwd_ct, new_pending, feed, stash, loss_acc,
                 grad_acc), None
 
+    feed0 = (varying(microbatches[0]) if microbatches_distributed
+             else varying(jnp.zeros((), mb_shape.dtype)))
     init = (
         varying(jnp.zeros_like(mb_shape)),                  # fwd ring
         varying(jnp.zeros_like(mb_shape)),                  # bwd ring
         varying(jnp.zeros_like(mb_shape)),                  # pending ct
+        feed0,                                              # feed ring
         varying(jnp.zeros((n_slots,) + mb_shape.shape,
                           mb_shape.dtype)),                 # stash
         varying(jnp.zeros((), jnp.float32)),                # loss acc
@@ -295,7 +331,7 @@ def spmd_pipeline_1f1b(
         jax.tree.map(jnp.zeros_like, params_local),          # grad acc
     )
     carry, _ = lax.scan(tick, init, jnp.arange(n_ticks))
-    _, _, _, _, loss_acc, grad_acc = carry
+    loss_acc, grad_acc = carry[-2], carry[-1]
     return loss_acc, grad_acc
 
 
@@ -511,15 +547,31 @@ def forward_backward_pipelining_without_interleaving(
     mbs = batch.reshape(m, batch.shape[0] // m, *batch.shape[1:])
     pspec = params_spec if params_spec is not None else P(axis)
 
+    # shard the microbatch axis over the pipe ranks (cyclic: rank r
+    # holds microbatches r::pp) so per-rank input memory is O(M/pp) —
+    # the feed ring inside spmd_pipeline_1f1b streams them to rank 0.
+    # M not divisible by pp falls back to the replicated form.
+    pp_size = mesh.shape[axis]
+    distributed = pp_size > 1 and m % pp_size == 0
+    if distributed:
+        mbs = jnp.swapaxes(
+            mbs.reshape(m // pp_size, pp_size, *mbs.shape[1:]), 0, 1)
+        mb_spec = P(axis)
+    else:
+        mb_spec = P()
+
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(pspec, P()), out_specs=(P(), pspec),
+        in_specs=(pspec, mb_spec), out_specs=(P(), pspec),
         # only `pipe` goes manual: data/tensor axes inside the stage
         # remain GSPMD-managed, so TP layers compose with the pipeline
         axis_names={axis})
     def run(params_local, mbs_local):
+        if distributed:
+            mbs_local = mbs_local[0]     # strip the split pp dim
         loss_local, grads_local = spmd_pipeline_1f1b(
-            stage_fn, loss_fn, params_local, mbs_local, axis=axis)
+            stage_fn, loss_fn, params_local, mbs_local, axis=axis,
+            microbatches_distributed=distributed)
         # loss_local is the per-microbatch sum on rank pp-1, 0 elsewhere
         loss = lax.psum(loss_local, axis) / m
         # restore the stripped stacked-stage axis for the out_spec

@@ -131,7 +131,7 @@ class TestPipelineSchedule:
         def loss_fn(y, idx):
             return jnp.mean(y ** 2)
 
-        def temp_bytes(m):
+        def mem_stats(m):
             f = jax.jit(
                 lambda p, b: forward_backward_pipelining_without_interleaving(
                     _stage_fn, loss_fn, p, b, mesh=mesh8,
@@ -143,13 +143,21 @@ class TestPipelineSchedule:
                 jax.ShapeDtypeStruct((m * MB, SEQ, HID), jnp.float32))
             stats = lowered.compile().memory_analysis()
             assert stats is not None
-            return stats.temp_size_in_bytes
+            return stats.temp_size_in_bytes, stats.argument_size_in_bytes
 
-        t4, t32 = temp_bytes(4), temp_bytes(32)
+        (t4, a4), (t32, a32) = mem_stats(4), mem_stats(32)
         # flat in M: 8x the microbatches must not grow live memory by
         # more than a small constant (scan bookkeeping); O(M) stashing
         # would show up as ~8x
         assert t32 <= 1.5 * t4 + 4096, (t4, t32)
+        # inputs are cyclically sharded over pipe + streamed by the feed
+        # ring, so per-rank argument memory grows by (M2-M1)/pp
+        # microbatches, not (M2-M1) (O(M) replication)
+        mb_bytes = MB * SEQ * HID * 4
+        pp = mesh8.shape[PIPE_AXIS]
+        grown = a32 - a4
+        assert grown <= 1.5 * (32 - 4) * mb_bytes / pp + 4096, (
+            a4, a32, mb_bytes)
 
     def test_no_pipelining_accumulation(self, rng):
         params = jnp.asarray(rng.normal(size=(HID, HID)), jnp.float32)
