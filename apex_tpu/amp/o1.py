@@ -71,6 +71,8 @@ _FP32_MODULES = ("layernorm", "batchnorm", "groupnorm", "rmsnorm",
 
 
 def classify_module(cls_name: str) -> str:
+    """Classify a flax module class name for O1 ("half" / "fp32" /
+    "passthrough") — the module-level analogue of ``classify_op``."""
     low = cls_name.lower()
     for frag in _FP32_MODULES:
         if frag in low:

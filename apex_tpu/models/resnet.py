@@ -101,6 +101,8 @@ class ResNet(nn.Module):
 
 
 def resnet50(**kw) -> ResNet:
+    """ResNet-50 (3-4-6-3 bottleneck stages) — the reference's
+    ``examples/imagenet`` workload (BASELINE.json configs[0])."""
     return ResNet(ResNetConfig(stage_sizes=(3, 4, 6, 3), **kw))
 
 

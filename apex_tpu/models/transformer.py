@@ -161,17 +161,22 @@ class ParallelAttention(nn.Module):
             cos, sin = rope_cos_sin(s, rot, base=cfg.rope_base)
             q = fused_rope(q, cos, sin)
             k = fused_rope(k, cos, sin)
-        o = fused_attention(q, k, v, causal=cfg.causal, bias=mask_bias,
-                            block_q=cfg.attention_block_q,
-                            block_k=cfg.attention_block_k)
+        # attention-prob dropout runs INSIDE the flash kernel (counter-
+        # hash mask, regenerated in the backward kernels) — the dropout
+        # path no longer bypasses the Pallas attention
+        drop = cfg.attention_dropout if (
+            cfg.attention_dropout > 0.0 and not deterministic) else 0.0
+        o = fused_attention(
+            q, k, v, causal=cfg.causal, bias=mask_bias,
+            dropout_rate=drop,
+            dropout_rng=self.make_rng("dropout") if drop > 0.0 else None,
+            block_q=cfg.attention_block_q,
+            block_k=cfg.attention_block_k)
         # named so remat_policy="save_only:attn_out" can keep the flash
         # output (cheap: b·s·h bf16) and skip recomputing the whole
         # attention in backward
         from jax.ad_checkpoint import checkpoint_name
         o = checkpoint_name(o, "attn_out")
-        if cfg.attention_dropout > 0.0 and not deterministic:
-            o = nn.Dropout(rate=cfg.attention_dropout)(
-                o, deterministic=False)
         o = o.reshape(b, s, h * d)
         return RowParallelLinear(
             features=cfg.hidden_size, use_bias=True,

@@ -27,6 +27,7 @@ with fewer heads and ``num_kv_heads`` dividing ``num_heads``.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 import jax
@@ -39,6 +40,78 @@ from apex_tpu.ops._dispatch import resolve_impl
 __all__ = ["fused_attention", "attention_reference", "mask_to_bias"]
 
 _NEG_INF = -1e30
+_logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------- #
+# attention-prob dropout — counter-based hash, identical in the Pallas
+# kernels and the XLA composition
+# --------------------------------------------------------------------- #
+# The reference's fused MHA kernels take a dropout prob and drop
+# attention probabilities in-kernel (apex/contrib/multihead_attn, the
+# *_dropout_* kernel variants).  Here the mask is a pure function of
+# (seed, batch*head lane, global q position, global k position) — a
+# murmur3-fmix32 counter hash — so the forward kernel, both backward
+# kernels and the jnp reference regenerate bit-identical masks with no
+# mask tensor ever materialized in HBM, and the golden tests compare
+# kernel vs composition exactly.  (pltpu.prng_random_bits would tie the
+# mask to grid iteration order and has no CPU-interpret support.)
+
+def _fmix32(x):
+    """murmur3 finalizer — avalanche a uint32 counter."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _drop_threshold(rate: float) -> int:
+    return min(int(rate * 4294967296.0), 4294967295)
+
+
+def _keep_from_counters(seed_u32, lane_u32, q_pos, k_pos, sk, rate):
+    """Boolean keep-mask from integer position counters (any shape).
+
+    ``seed_u32``/``lane_u32`` scalars (or broadcastable), ``q_pos`` /
+    ``k_pos`` int32 arrays of the tile's global positions.  Two hash
+    stages (row, then column) instead of a flat ``q*sk + k`` counter:
+    the flat product wraps uint32 at ~64k×64k and would alias whole
+    mask rows at long context; here ``q -> fmix32(q*C + h)`` is a
+    bijection on uint32, so distinct (q, k) pairs never collide by
+    construction at any sequence length."""
+    del sk  # no longer part of the counter (wraps at long context)
+    h = seed_u32 ^ (lane_u32 * jnp.uint32(0x9E3779B9))
+    row = _fmix32(q_pos.astype(jnp.uint32) * jnp.uint32(0x9E3779B9) + h)
+    x = _fmix32(row ^ (k_pos.astype(jnp.uint32)
+                       * jnp.uint32(0x85EBCA6B)))
+    return x >= jnp.uint32(_drop_threshold(rate))
+
+
+def _dropout_keep_tile(seed_ref, lane, i, j, bq, bk, sk, rate):
+    """(bq, bk) keep-mask for grid tile (lane, i, j) — in-kernel form."""
+    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    seed = seed_ref[0].astype(jnp.uint32)
+    return _keep_from_counters(seed, jnp.uint32(lane), q_pos, k_pos,
+                               sk, rate)
+
+
+def dropout_keep_mask(seed, b, h, sq, sk, rate):
+    """(b, h, sq, sk) keep-mask — the plain-jnp form of the kernels'
+    in-tile hash (bit-identical), used by the XLA composition and the
+    golden tests."""
+    lane = (jnp.arange(b, dtype=jnp.uint32)[:, None] * jnp.uint32(h)
+            + jnp.arange(h, dtype=jnp.uint32)[None, :])   # (b, h)
+    q_pos = jnp.arange(sq, dtype=jnp.int32)
+    k_pos = jnp.arange(sk, dtype=jnp.int32)
+    keep = _keep_from_counters(
+        jnp.asarray(0 if seed is None else seed).astype(jnp.uint32),
+        lane[:, :, None, None],
+        q_pos[None, None, :, None], k_pos[None, None, None, :],
+        sk, rate)
+    return keep
 
 
 def mask_to_bias(masked):
@@ -55,12 +128,17 @@ def mask_to_bias(masked):
 # XLA reference composition (golden semantics; CPU/GPU fallback)
 # --------------------------------------------------------------------- #
 def attention_reference(q, k, v, *, causal: bool = False,
-                        scale: Optional[float] = None, bias=None):
+                        scale: Optional[float] = None, bias=None,
+                        dropout_rate: float = 0.0,
+                        dropout_seed=None):
     """Eager attention: softmax(q·kᵀ·scale + bias [causal]) · v.
 
     Shapes: q (b, sq, h, d); k/v (b, sk, hk, d) with h % hk == 0.
     Query rows with no visible key (causal with sq > sk) output zeros —
     the flash-attention convention, matched by the Pallas kernel.
+    ``dropout_rate`` drops attention probabilities post-softmax using
+    the counter-hash mask (:func:`dropout_keep_mask`) — bit-identical
+    to the Pallas kernels' in-tile dropout.
     """
     b, sq, h, d = q.shape
     hk = k.shape[2]
@@ -84,6 +162,10 @@ def attention_reference(q, k, v, *, causal: bool = False,
         # exactly zero probability; fully-dead rows output zeros — the
         # flash-attention convention, matched by the Pallas kernel
         p = jnp.where(s < 0.5 * _NEG_INF, 0.0, p)
+    if dropout_rate > 0.0:
+        keep = dropout_keep_mask(dropout_seed, b, h, sq, k.shape[1],
+                                 dropout_rate)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return o.astype(q.dtype)
 
@@ -91,17 +173,23 @@ def attention_reference(q, k, v, *, causal: bool = False,
 # --------------------------------------------------------------------- #
 # forward kernel
 # --------------------------------------------------------------------- #
-def _scores(q_ref, k_ref, kvb_ref, i, j, *, scale, causal, bq, bk,
-            sq, sk):
+def _scores(q_ref, k_ref, kvb_ref, i, j, *, scale, causal, per_q, bq,
+            bk, sq, sk):
     """Scaled scores for one (q-block, kv-block) tile: qkᵀ·scale
-    (+ kv bias) with causal positions pushed to -inf."""
+    (+ bias) with causal positions pushed to -inf.
+
+    ``per_q``: the bias block is (1, bq, bk) (per-query rows, e.g.
+    relative-position bias) instead of the (1, 1, bk) per-key row."""
     q = q_ref[0].astype(jnp.float32)               # (bq, d)
     k = k_ref[0].astype(jnp.float32)               # (bk, d)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # (bq, bk)
     if kvb_ref is not None:
-        s = s + kvb_ref[0, 0][None, :]             # (1, 1, bk) kv bias
+        if per_q:
+            s = s + kvb_ref[0]                     # (bq, bk) tile
+        else:
+            s = s + kvb_ref[0, 0][None, :]         # (1, 1, bk) kv bias
     if causal:
         q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -118,16 +206,18 @@ def _zero_dead(s, p, causal, has_bias):
     return p
 
 
-def _fa_fwd_kernel(*refs, scale, causal, has_bias, bq, bk, sk_blocks,
-                   sq, sk):
-    if has_bias:
-        (q_ref, k_ref, v_ref, kvb_ref, o_ref, lse_ref,
-         acc_ref, m_ref, l_ref) = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-        kvb_ref = None
+def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
+                   sk_blocks, sq, sk):
+    n = 3
+    q_ref, k_ref, v_ref = refs[:3]
+    kvb_ref = refs[n] if has_bias else None
+    n += 1 if has_bias else 0
+    seed_ref = refs[n] if rate > 0.0 else None
+    n += 1 if rate > 0.0 else 0
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[n:]
     j = pl.program_id(2)
     i = pl.program_id(1)
+    lane = pl.program_id(0)
 
     @pl.when(j == 0)
     def _init():
@@ -144,12 +234,20 @@ def _fa_fwd_kernel(*refs, scale, causal, has_bias, bq, bk, sk_blocks,
     def _step():
         v = v_ref[0].astype(jnp.float32)
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
-                    causal=causal, bq=bq, bk=bk, sq=sq, sk=sk)
+                    causal=causal, per_q=per_q, bq=bq, bk=bk, sq=sq,
+                    sk=sk)
         m_prev = m_ref[:]                          # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = _zero_dead(s, jnp.exp(s - m_new), causal, has_bias)
         alpha = jnp.exp(m_prev - m_new)            # (bq, 1)
+        # the normalizer accumulates the UNDROPPED probabilities (the
+        # softmax denominator is dropout-independent, torch semantics);
+        # only the value accumulation sees the dropped/rescaled probs
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if rate > 0.0:
+            keep = _dropout_keep_tile(seed_ref, lane, i, j, bq, bk,
+                                      sk, rate)
+            p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -177,28 +275,56 @@ def _qkv_specs(d, bq, bk, rep):
     ]
 
 
-def _kvb_spec(bk, nh):
-    """(batch, 1, sk) kv-bias block under grid (b*h, i, j):
-    batch = b // nh.  The middle singleton keeps the block's last two
-    dims TPU-tileable ((1, bk): 1 == array dim, bk % 128 == 0)."""
-    return pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // nh, 0, j),
+def _bias_spec(mode, nh, bq, bk, *, swapped: bool = False):
+    """BlockSpec for the normalized (B0*H0, S0, sk) bias.
+
+    ``mode = (has_batch, has_head, per_q)`` statics; the leading array
+    index is ``batch*H0 + head`` with H0 == nh when has_head.  The
+    per-key form keeps a middle singleton so the block's last two dims
+    stay TPU-tileable.  ``swapped``: the dkv grid is (b, j, i)."""
+    has_batch, has_head, per_q = mode
+    h0 = nh if has_head else 1
+
+    def lead(bb):
+        batch = bb // nh if has_batch else 0
+        head = (bb % nh) if has_head else 0
+        return batch * h0 + head
+
+    if per_q:
+        if swapped:
+            return pl.BlockSpec((1, bq, bk),
+                                lambda b, j, i: (lead(b), i, j),
+                                memory_space=pltpu.VMEM)
+        return pl.BlockSpec((1, bq, bk), lambda b, i, j: (lead(b), i, j),
+                            memory_space=pltpu.VMEM)
+    if swapped:
+        return pl.BlockSpec((1, 1, bk), lambda b, j, i: (lead(b), 0, j),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, 1, bk), lambda b, i, j: (lead(b), 0, j),
                         memory_space=pltpu.VMEM)
 
 
-def _run_fa_fwd(q3, k3, v3, kvb, scale, causal, rep, nh, bq, bk,
-                interpret):
+_SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode, rate,
+                rep, nh, bq, bk, interpret):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     grid = (bh, sq // bq, sk // bk)
     has_bias = kvb is not None
     kernel = functools.partial(
         _fa_fwd_kernel, scale=scale, causal=causal, has_bias=has_bias,
+        per_q=bool(bias_mode and bias_mode[2]), rate=rate,
         bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq, sk=sk)
     in_specs = _qkv_specs(d, bq, bk, rep)
     args = [q3, k3, v3]
     if has_bias:
-        in_specs.append(_kvb_spec(bk, nh))
+        in_specs.append(_bias_spec(bias_mode, nh, bq, bk))
         args.append(kvb)
+    if rate > 0.0:
+        in_specs.append(_SEED_SPEC)
+        args.append(seed)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -228,15 +354,17 @@ def _run_fa_fwd(q3, k3, v3, kvb, scale, causal, rep, nh, bq, bk,
 # backward kernels
 # --------------------------------------------------------------------- #
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
-                      *refs, scale, causal, has_bias, bq, bk,
-                      sk_blocks, sq, sk):
-    if has_bias:
-        kvb_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs
-    else:
-        do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs
-        kvb_ref = None
+                      *refs, scale, causal, has_bias, per_q, rate, bq,
+                      bk, sk_blocks, sq, sk):
+    n = 0
+    kvb_ref = refs[n] if has_bias else None
+    n += 1 if has_bias else 0
+    seed_ref = refs[n] if rate > 0.0 else None
+    n += 1 if rate > 0.0 else 0
+    do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs[n:]
     j = pl.program_id(2)
     i = pl.program_id(1)
+    lane = pl.program_id(0)
 
     @pl.when(j == 0)
     def _init():
@@ -253,13 +381,20 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
         lse = lse_ref[0, 0][:, None]               # (bq, 1)
         delta = delta_ref[0, 0][:, None]
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
-                    causal=causal, bq=bq, bk=bk, sq=sq, sk=sk)
+                    causal=causal, per_q=per_q, bq=bq, bk=bk, sq=sq,
+                    sk=sk)
         # dead rows have lse == -inf making exp(s - lse) == 1 there;
         # _zero_dead restores exact zeros
         p = _zero_dead(s, jnp.exp(s - lse), causal, has_bias)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bq, bk)
+        if rate > 0.0:
+            # dS = P ∘ (D∘dP - delta): same mask as the forward tile;
+            # delta = rowsum(dO·O) already contains the dropout factor
+            keep = _dropout_keep_tile(seed_ref, lane, i, j, bq, bk,
+                                      sk, rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - delta) * scale
         acc_ref[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -271,16 +406,17 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
-                       *refs, scale, causal, has_bias, bq, bk,
-                       sq_blocks, sq, sk):
-    if has_bias:
-        kvb_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, \
-            dk_acc, dv_acc = refs
-    else:
-        do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
-        kvb_ref = None
+                       *refs, scale, causal, has_bias, per_q, rate, bq,
+                       bk, sq_blocks, sq, sk):
+    n = 0
+    kvb_ref = refs[n] if has_bias else None
+    n += 1 if has_bias else 0
+    seed_ref = refs[n] if rate > 0.0 else None
+    n += 1 if rate > 0.0 else 0
+    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs[n:]
     i = pl.program_id(2)      # q block (sequential axis)
     j = pl.program_id(1)      # kv block
+    lane = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
@@ -298,15 +434,25 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
-                    causal=causal, bq=bq, bk=bk, sq=sq, sk=sk)
+                    causal=causal, per_q=per_q, bq=bq, bk=bk, sq=sq,
+                    sk=sk)
         p = _zero_dead(s, jnp.exp(s - lse), causal, has_bias)
-        # dv += pᵀ @ do
+        if rate > 0.0:
+            keep = _dropout_keep_tile(seed_ref, lane, i, j, bq, bk,
+                                      sk, rate)
+            inv = 1.0 / (1.0 - rate)
+            pd = jnp.where(keep, p * inv, 0.0)     # dropped probs
+        else:
+            keep, pd = None, p
+        # dv += (P∘D)ᵀ @ do
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pd, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta) * scale              # (bq, bk)
         # dk += dsᵀ @ q
         dk_acc[:] += jax.lax.dot_general(
@@ -319,22 +465,27 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _run_fa_bwd(q3, k3, v3, kvb, o3, lse, do3, scale, causal, rep, nh,
-                bq, bk, interpret):
+def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
+                bias_mode, rate, rep, nh, bq, bk, interpret):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     has_bias = kvb is not None
+    per_q = bool(bias_mode and bias_mode[2])
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]           # (bh, 1, sq)
 
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, scale=scale, causal=causal, has_bias=has_bias,
-        bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq, sk=sk)
+        per_q=per_q, rate=rate, bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq,
+        sk=sk)
     in_specs = _qkv_specs(d, bq, bk, rep)
     args = [q3, k3, v3]
     if has_bias:
-        in_specs.append(_kvb_spec(bk, nh))
+        in_specs.append(_bias_spec(bias_mode, nh, bq, bk))
         args.append(kvb)
+    if rate > 0.0:
+        in_specs.append(_SEED_SPEC)
+        args.append(seed)
     in_specs += [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                      memory_space=pltpu.VMEM),
@@ -356,8 +507,8 @@ def _run_fa_bwd(q3, k3, v3, kvb, o3, lse, do3, scale, causal, rep, nh,
 
     dkv_kernel = functools.partial(
         _fa_bwd_dkv_kernel, scale=scale, causal=causal,
-        has_bias=has_bias, bq=bq, bk=bk, sq_blocks=sq // bq, sq=sq,
-        sk=sk)
+        has_bias=has_bias, per_q=per_q, rate=rate, bq=bq, bk=bk,
+        sq_blocks=sq // bq, sq=sq, sk=sk)
     # dk/dv are computed per *q* head (grid axis 0 = b*h) so each output
     # block is owned by one grid lane; for GQA the rep-sized head groups
     # are summed afterwards (cheap, fp32) instead of making the kernel
@@ -373,10 +524,11 @@ def _run_fa_bwd(q3, k3, v3, kvb, o3, lse, do3, scale, causal, rep, nh,
     ]
     args = [q3, k3, v3]
     if has_bias:
-        in_specs.append(
-            pl.BlockSpec((1, 1, bk), lambda b, j, i: (b // nh, 0, j),
-                         memory_space=pltpu.VMEM))
+        in_specs.append(_bias_spec(bias_mode, nh, bq, bk, swapped=True))
         args.append(kvb)
+    if rate > 0.0:
+        in_specs.append(_SEED_SPEC)
+        args.append(seed)
     in_specs += [
         pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
                      memory_space=pltpu.VMEM),
@@ -418,27 +570,32 @@ def _run_fa_bwd(q3, k3, v3, kvb, o3, lse, do3, scale, causal, rep, nh,
 # --------------------------------------------------------------------- #
 # custom VJP over (b*h, s, d) arrays
 # --------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
-def _fa_pallas(q3, k3, v3, kvb, scale, causal, rep, nh, bq, bk,
-               interpret):
-    o, _ = _run_fa_fwd(q3, k3, v3, kvb, scale, causal, rep, nh, bq, bk,
-                       interpret)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13))
+def _fa_pallas(q3, k3, v3, kvb, seed, scale, causal, bias_mode, rate,
+               rep, nh, bq, bk, interpret):
+    o, _ = _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode,
+                       rate, rep, nh, bq, bk, interpret)
     return o
 
 
-def _fa_pallas_fwd(q3, k3, v3, kvb, scale, causal, rep, nh, bq, bk,
-                   interpret):
-    o, lse = _run_fa_fwd(q3, k3, v3, kvb, scale, causal, rep, nh, bq,
-                         bk, interpret)
-    return o, (q3, k3, v3, kvb, o, lse)
+def _fa_pallas_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode,
+                   rate, rep, nh, bq, bk, interpret):
+    o, lse = _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal,
+                         bias_mode, rate, rep, nh, bq, bk, interpret)
+    return o, (q3, k3, v3, kvb, seed, o, lse)
 
 
-def _fa_pallas_bwd(scale, causal, rep, nh, bq, bk, interpret, res, do):
-    q3, k3, v3, kvb, o, lse = res
-    dq, dk, dv = _run_fa_bwd(q3, k3, v3, kvb, o, lse, do, scale, causal,
-                             rep, nh, bq, bk, interpret)
-    # kv bias comes from a padding mask — not differentiated
-    return dq, dk, dv, None
+def _fa_pallas_bwd(scale, causal, bias_mode, rate, rep, nh, bq, bk,
+                   interpret, res, do):
+    q3, k3, v3, kvb, seed, o, lse = res
+    dq, dk, dv = _run_fa_bwd(q3, k3, v3, kvb, seed, o, lse, do, scale,
+                             causal, bias_mode, rate, rep, nh, bq, bk,
+                             interpret)
+    # the bias is treated as a constant (padding masks / ALiBi slopes);
+    # learned biases must pass bias_requires_grad=True at the API level,
+    # which routes to the differentiable XLA composition
+    return dq, dk, dv, None, None
 
 
 _fa_pallas.defvjp(_fa_pallas_fwd, _fa_pallas_bwd)
@@ -463,19 +620,68 @@ def _pick_block(s: int, want: int) -> int:
     # VMEM; otherwise return `want` (won't divide s -> XLA fallback)
     return s if s <= 2 * want else want
 
+def _normalize_bias(bias, b, h, sq, sk):
+    """Normalize a broadcastable 4-d additive bias to the kernels'
+    (B0*H0, S0, sk) layout + static ``(has_batch, has_head, per_q)``
+    mode.  Returns (None, None) when the bias can't ride the kernel
+    (wrong rank, unbroadcastable dims, or a sub-sk key dim)."""
+    if bias is None or bias.ndim != 4:
+        return None, None
+    b0, h0, s0, k0 = bias.shape
+    if (k0 != sk or b0 not in (1, b) or h0 not in (1, h)
+            or s0 not in (1, sq)):
+        return None, None
+    mode = (b0 == b, h0 == h, s0 == sq)
+    bias3 = bias.reshape(b0 * h0, s0, sk).astype(jnp.float32)
+    return bias3, mode
+
+
+def _derive_seed(dropout_rng) -> jnp.ndarray:
+    """(1,) int32 seed from a PRNG key or python/array integer."""
+    if dropout_rng is None:
+        return jnp.zeros((1,), jnp.int32)
+    if isinstance(dropout_rng, (int, jnp.integer)):
+        return jnp.asarray([dropout_rng], jnp.int32)
+    arr = jnp.asarray(dropout_rng)
+    if jnp.issubdtype(arr.dtype, jax.dtypes.prng_key) or (
+            arr.dtype == jnp.uint32 and arr.shape == (2,)):
+        key = arr if jnp.issubdtype(
+            arr.dtype, jax.dtypes.prng_key) else \
+            jax.random.wrap_key_data(arr)
+        return jax.random.randint(
+            key, (1,), jnp.iinfo(jnp.int32).min,
+            jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    return arr.reshape(1).astype(jnp.int32)
+
+
 def fused_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     bias=None,
+                    bias_requires_grad: bool = False,
+                    dropout_rate: float = 0.0,
+                    dropout_rng=None,
                     block_q: int = 512, block_k: int = 512,
                     implementation: Optional[str] = None):
     """Flash multi-head attention (BSHD layout), O(S) memory.
 
     Drop-in for the reference's ``SelfMultiheadAttn`` core /
-    ``fmha`` (SURVEY.md §2.7).  A ``bias`` broadcastable as
-    ``(b, 1, 1, sk)`` — e.g. a key-padding mask from
-    :func:`mask_to_bias` — rides the Pallas kernel; richer biases
-    (per-query/per-head) route to the XLA composition.  GQA/MQA
-    supported via fewer kv heads.
+    ``fmha`` (SURVEY.md §2.7).  GQA/MQA supported via fewer kv heads.
+
+    ``bias``: any additive bias broadcastable as ``(b|1, h|1, sq|1,
+    sk)`` rides the Pallas kernel — key-padding rows from
+    :func:`mask_to_bias`, per-head ALiBi ``(1, h, 1, sk)``,
+    relative-position / full score biases ``(b|1, h, sq, sk)``.  The
+    kernel treats the bias as a constant; set
+    ``bias_requires_grad=True`` for a *learned* bias (T5-style) to get
+    its gradient via the XLA composition instead (O(S²), logged).
+
+    ``dropout_rate``: in-kernel attention-probability dropout — the
+    reference's fused-MHA dropout semantics (softmax denominator
+    undropped, probs dropped and rescaled before the value matmul).
+    The mask is a counter hash of (seed, lane, positions), regenerated
+    bit-identically in the backward kernels and in
+    :func:`attention_reference` (pass the same seed to cross-check).
+    ``dropout_rng`` accepts a JAX PRNG key or an integer seed.
     """
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
@@ -485,16 +691,12 @@ def fused_attention(q, k, v, *, causal: bool = False,
     scale = (d ** -0.5) if scale is None else float(scale)
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
-    # a bias broadcastable as (b, 1, 1, sk) — e.g. a key-padding mask —
-    # rides the Pallas kernel as a per-key additive row; anything richer
-    # (per-query/per-head bias) falls back to the XLA composition
-    kvb = None
-    if bias is not None and bias.ndim == 4 and bias.shape[1:3] == (1, 1) \
-            and bias.shape[3] == sk and bias.shape[0] in (1, b):
-        kvb = jnp.broadcast_to(
-            bias[:, 0, 0, :], (b, sk)).astype(jnp.float32)[:, None, :]
+    kvb, bias_mode = _normalize_bias(bias, b, h, sq, sk)
+    rate = float(dropout_rate)
+    seed = _derive_seed(dropout_rng) if rate > 0.0 else None
     pallas_ok = (
         (bias is None or kvb is not None)
+        and not (bias is not None and bias_requires_grad)
         # blocks span the whole head dim, so any multiple of the fp32
         # sublane works (d=64 covers BERT-Large; 128 fills MXU lanes)
         and d % 8 == 0
@@ -503,14 +705,24 @@ def fused_attention(q, k, v, *, causal: bool = False,
     )
     impl = resolve_impl(implementation, pallas_ok=pallas_ok)
     if impl == "xla" or not pallas_ok:
-        return attention_reference(q, k, v, causal=causal, scale=scale,
-                                   bias=bias)
+        if implementation in (None, "auto") and not pallas_ok:
+            reason = ("bias_requires_grad" if bias_requires_grad
+                      else "bias shape" if bias is not None
+                      and kvb is None else "shape/dtype constraints")
+            _logger.info(
+                "fused_attention: falling back to the O(S^2) XLA "
+                "composition (%s); q=%s bias=%s", reason, q.shape,
+                None if bias is None else bias.shape)
+        seed_val = seed[0] if seed is not None else 0
+        return attention_reference(
+            q, k, v, causal=causal, scale=scale, bias=bias,
+            dropout_rate=rate, dropout_seed=seed_val)
     interpret = impl == "pallas_interpret"
     # (b, s, h, d) -> (b*h, s, d); GQA kv stays at (b*hk, s, d) — the
     # kernels' kv BlockSpecs map rep consecutive q heads to one kv head
     q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     k3 = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
     v3 = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
-    o3 = _fa_pallas(q3, k3, v3, kvb, scale, bool(causal), h // hk, h,
-                    bq, bk, interpret)
+    o3 = _fa_pallas(q3, k3, v3, kvb, seed, scale, bool(causal),
+                    bias_mode, rate, h // hk, h, bq, bk, interpret)
     return o3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

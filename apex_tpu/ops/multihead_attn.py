@@ -96,10 +96,16 @@ class SelfMultiheadAttn(nn.Module):
             dtype=dtype, param_dtype=self.param_dtype, name="qkv_proj")(x)
         q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :],
                    qkv[..., 2, :, :])
-        o = fused_attention(q, k, v, causal=self.causal,
-                            bias=_attention_bias(mask, key_padding_mask))
-        if self.dropout > 0.0 and not deterministic:
-            o = nn.Dropout(rate=self.dropout)(o, deterministic=False)
+        # attention-PROB dropout inside the kernel — the reference's
+        # fused-MHA dropout semantics (apex multihead_attn kernels drop
+        # softmax probabilities, not the attention output)
+        drop = self.dropout if (self.dropout > 0.0
+                                and not deterministic) else 0.0
+        o = fused_attention(
+            q, k, v, causal=self.causal,
+            bias=_attention_bias(mask, key_padding_mask),
+            dropout_rate=drop,
+            dropout_rng=self.make_rng("dropout") if drop > 0.0 else None)
         o = o.reshape(*o.shape[:-2], self.embed_dim)
         out = nn.Dense(self.embed_dim, use_bias=self.bias, dtype=dtype,
                        param_dtype=self.param_dtype, name="out_proj")(o)
@@ -147,10 +153,12 @@ class EncdecMultiheadAttn(nn.Module):
                              param_dtype=self.param_dtype,
                              name="kv_proj")(key_value)
         k, v = kv[..., 0, :, :], kv[..., 1, :, :]
-        o = fused_attention(q, k, v,
-                            bias=_attention_bias(mask, key_padding_mask))
-        if self.dropout > 0.0 and not deterministic:
-            o = nn.Dropout(rate=self.dropout)(o, deterministic=False)
+        drop = self.dropout if (self.dropout > 0.0
+                                and not deterministic) else 0.0
+        o = fused_attention(
+            q, k, v, bias=_attention_bias(mask, key_padding_mask),
+            dropout_rate=drop,
+            dropout_rng=self.make_rng("dropout") if drop > 0.0 else None)
         o = o.reshape(*o.shape[:-2], self.embed_dim)
         out = nn.Dense(self.embed_dim, use_bias=self.bias, dtype=dtype,
                        param_dtype=self.param_dtype, name="out_proj")(o)

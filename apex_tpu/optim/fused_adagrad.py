@@ -29,6 +29,8 @@ def fused_adagrad(
     weight_decay: float = 0.0,
     initial_accumulator_value: float = 0.0,
 ) -> optax.GradientTransformation:
+    """Adagrad as one fused pytree update (reference
+    ``apex.optimizers.FusedAdagrad`` / ``amp_C.multi_tensor_adagrad``)."""
     def init(params):
         return FusedAdagradState(
             count=jnp.zeros((), jnp.int32),

@@ -39,6 +39,9 @@ def fused_novograd(
     grad_averaging: bool = False,
     bias_correction: bool = False,
 ) -> optax.GradientTransformation:
+    """NovoGrad — layer-wise second moment (one scalar per tensor),
+    reference ``apex.optimizers.FusedNovoGrad`` incl. ``init_zero`` and
+    decoupled weight-decay semantics."""
     def init(params):
         return FusedNovoGradState(
             count=jnp.zeros((), jnp.int32),

@@ -31,6 +31,9 @@ def fused_sgd(
     weight_decay: float = 0.0,
     nesterov: bool = False,
 ) -> optax.GradientTransformation:
+    """SGD(+momentum/nesterov/weight-decay) as one fused pytree update
+    (reference ``apex.optimizers.FusedSGD`` /
+    ``amp_C.multi_tensor_sgd``) — torch-parity momentum semantics."""
     if nesterov and (momentum <= 0 or dampening != 0):
         raise ValueError(
             "Nesterov momentum requires a momentum and zero dampening")

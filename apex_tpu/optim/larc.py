@@ -34,6 +34,9 @@ def larc(
     eps: float = 1e-8,
     weight_decay: float = 0.0,
 ) -> optax.GradientTransformation:
+    """LARC — layer-wise adaptive rate clipping/scaling around any
+    update (reference ``apex.parallel.LARC``): per-leaf trust ratio
+    ``trust_coefficient * ||p|| / ||g||``, clipped at 1 in clip mode."""
     def init(params):
         return optax.ScaleState()
 

@@ -118,6 +118,8 @@ def build_num_microbatches_calculator(
     micro_batch_size: int,
     data_parallel_size: int,
 ):
+    """Constant or rampup calculator from the reference's
+    ``rampup_batch_size = [start, increment, ramp_samples]`` spec."""
     if rampup_batch_size is None:
         return ConstantNumMicroBatches(
             global_batch_size, micro_batch_size, data_parallel_size)
@@ -152,18 +154,24 @@ def _get():
 
 
 def get_num_microbatches() -> int:
+    """Current number of microbatches from the global calculator
+    (reference: ``apex.transformer.pipeline_parallel.utils``)."""
     return _get().get()
 
 
 def get_current_global_batch_size() -> int:
+    """Current global batch size (rampup-aware), reference name."""
     return _get().get_current_global_batch_size()
 
 
 def update_num_microbatches(consumed_samples: int,
                             consistency_check: bool = True) -> None:
+    """Advance the rampup schedule to ``consumed_samples`` (reference
+    name; no-op for the constant calculator)."""
     _get().update(consumed_samples, consistency_check)
 
 
 def destroy_microbatch_calculator() -> None:
+    """Reset the global calculator (test isolation, reference name)."""
     global _CALCULATOR
     _CALCULATOR = None

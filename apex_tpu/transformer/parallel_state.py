@@ -68,6 +68,8 @@ def initialize_model_parallel(
 
 
 def model_parallel_is_initialized() -> bool:
+    """True iff a global mesh exists (reference: the process-group
+    initialization flag)."""
     try:
         mesh_lib.get_mesh()
         return True
@@ -76,6 +78,8 @@ def model_parallel_is_initialized() -> bool:
 
 
 def destroy_model_parallel() -> None:
+    """Tear down the global mesh + virtual-pipeline state (reference
+    name; test-isolation helper)."""
     global _VIRTUAL_PIPE_SIZE
     _VIRTUAL_PIPE_SIZE = None
     mesh_lib.destroy_mesh()
@@ -83,56 +87,74 @@ def destroy_model_parallel() -> None:
 
 # ------------------------- world sizes ------------------------------- #
 def get_tensor_model_parallel_world_size() -> int:
+    """Size of the ``tensor`` mesh axis (reference: TP group size)."""
     return mesh_lib.mesh_axis_size(TENSOR_AXIS)
 
 
 def get_pipeline_model_parallel_world_size() -> int:
+    """Size of the ``pipe`` mesh axis (reference: PP group size)."""
     return mesh_lib.mesh_axis_size(PIPE_AXIS)
 
 
 def get_data_parallel_world_size() -> int:
+    """Combined ``data`` x ``fsdp`` axis size — the reference counts
+    sharded-optimizer replicas in its data-parallel group."""
     return (mesh_lib.mesh_axis_size(DATA_AXIS)
             * mesh_lib.mesh_axis_size(FSDP_AXIS))
 
 
 def get_context_parallel_world_size() -> int:
+    """Size of the ``context`` (sequence/ring) axis — beyond-reference
+    (apex has no CP); 1 unless context parallelism is configured."""
     return mesh_lib.mesh_axis_size(CONTEXT_AXIS)
 
 
 def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    """V of the interleaved schedule (model chunks per rank), or None
+    when not using virtual pipelining."""
     return _VIRTUAL_PIPE_SIZE
 
 
 # ------------------------- ranks (in-program) ------------------------ #
 def get_tensor_model_parallel_rank():
+    """This device's coordinate on the ``tensor`` axis — traced
+    (``lax.axis_index``): only meaningful inside shard_map/pjit."""
     return jax.lax.axis_index(TENSOR_AXIS)
 
 
 def get_pipeline_model_parallel_rank():
+    """This device's coordinate on the ``pipe`` axis (traced)."""
     return jax.lax.axis_index(PIPE_AXIS)
 
 
 def get_data_parallel_rank():
+    """This device's coordinate on the ``data`` axis (traced)."""
     return jax.lax.axis_index(DATA_AXIS)
 
 
 def is_pipeline_first_stage():
+    """Traced predicate: pipe coordinate == 0 (reference name)."""
     return jax.lax.axis_index(PIPE_AXIS) == 0
 
 
 def is_pipeline_last_stage():
+    """Traced predicate: pipe coordinate == pp - 1 (reference name)."""
     return (jax.lax.axis_index(PIPE_AXIS)
             == mesh_lib.mesh_axis_size(PIPE_AXIS) - 1)
 
 
 # ------------------------- axis names -------------------------------- #
 def get_tensor_model_parallel_axis() -> str:
+    """The ``tensor`` axis name — what replaces "the TP group" in
+    collectives and PartitionSpecs."""
     return TENSOR_AXIS
 
 
 def get_pipeline_model_parallel_axis() -> str:
+    """The ``pipe`` axis name."""
     return PIPE_AXIS
 
 
 def get_data_parallel_axis() -> str:
+    """The ``data`` axis name."""
     return DATA_AXIS

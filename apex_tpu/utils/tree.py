@@ -26,6 +26,7 @@ __all__ = [
 
 
 def is_floating(x: Any) -> bool:
+    """True iff ``x`` has a floating dtype (policy/cast predicates)."""
     return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
 
 

@@ -99,12 +99,64 @@ class TestForward:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-4, atol=1e-4)
 
-    def test_bias_falls_back_to_xla(self, rng):
-        # per-head bias is beyond the kv-bias kernel envelope -> XLA
+    @pytest.mark.parametrize("shape", [
+        (1, 2, 1, 128),    # per-head row (ALiBi)
+        (2, 1, 1, 128),    # per-batch key row (padding)
+        (1, 1, 128, 128),  # shared score bias
+        (1, 2, 128, 128),  # per-head relative-position
+        (2, 2, 128, 128),  # full
+    ])
+    def test_bias_tiles_ride_pallas(self, rng, shape):
+        """Every broadcastable (b|1, h|1, sq|1, sk) bias rides the
+        kernel (round-1 verdict item 6: ALiBi / relative-position must
+        not silently fall back to the O(S^2) composition)."""
+        q, k, v = _qkv(rng, sq=128, sk=128)
+        bias = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        got = fused_attention(q, k, v, bias=bias,
+                              implementation="pallas_interpret")
+        want = attention_reference(q, k, v, bias=bias)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_per_head_bias_grads(self, rng):
         q, k, v = _qkv(rng, sq=128, sk=128)
         bias = jnp.asarray(rng.normal(size=(1, 2, 128, 128)), jnp.float32)
-        got = fused_attention(q, k, v, bias=bias, implementation="auto")
-        want = attention_reference(q, k, v, bias=bias)
+
+        def f(impl):
+            def loss(q, k, v):
+                o = fused_attention(q, k, v, bias=bias, causal=True,
+                                    implementation=impl)
+                return jnp.sum(o * o)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        for g, w, name in zip(f("pallas_interpret"), f("xla"), "qkv"):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=name)
+
+    def test_learned_bias_requires_grad_routes_to_xla(self, rng):
+        """A learned bias needs its gradient — bias_requires_grad=True
+        must use the differentiable composition (and actually produce a
+        non-zero bias cotangent)."""
+        q, k, v = _qkv(rng, sq=128, sk=128)
+        bias = jnp.asarray(rng.normal(size=(1, 2, 128, 128)) * 0.1,
+                           jnp.float32)
+
+        def loss(bias):
+            o = fused_attention(q, k, v, bias=bias,
+                                bias_requires_grad=True,
+                                implementation="auto")
+            return jnp.sum(o * o)
+
+        db = jax.grad(loss)(bias)
+        assert float(jnp.abs(db).max()) > 0.0
+
+    def test_3d_bias_falls_back_to_xla(self, rng):
+        q, k, v = _qkv(rng, sq=128, sk=128)
+        bias = jnp.asarray(rng.normal(size=(2, 128, 128)), jnp.float32)
+        got = fused_attention(q, k, v, bias=bias[:, None],
+                              implementation="auto")
+        want = attention_reference(q, k, v, bias=bias[:, None])
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
 
@@ -241,6 +293,93 @@ class TestBackward:
         for gf, gr in zip(f("pallas_interpret"), f("xla")):
             np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                        rtol=1e-3, atol=1e-3)
+
+
+class TestDropout:
+    """In-kernel attention-prob dropout (round-1 verdict item 6:
+    reference multihead_attn kernels drop softmax probabilities with
+    RNG replay in backward).  The counter-hash mask is regenerated
+    bit-identically by the kernels and the jnp composition, so these
+    are exact golden tests, not statistical ones."""
+
+    def test_fwd_matches_reference_same_seed(self, rng):
+        q, k, v = _qkv(rng)
+        got = fused_attention(q, k, v, dropout_rate=0.2,
+                              dropout_rng=1234,
+                              implementation="pallas_interpret")
+        want = attention_reference(q, k, v, dropout_rate=0.2,
+                                   dropout_seed=1234)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_rate_zero_is_identity(self, rng):
+        q, k, v = _qkv(rng, sq=128, sk=128)
+        a = fused_attention(q, k, v, dropout_rate=0.0,
+                            implementation="pallas_interpret")
+        b = fused_attention(q, k, v, implementation="pallas_interpret")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, rng, causal):
+        """RNG replay in backward: the dq and dkv kernels regenerate
+        the forward's exact mask."""
+        q, k, v = _qkv(rng, sq=128, sk=128)
+
+        def f(impl):
+            def loss(q, k, v):
+                if impl == "xla":
+                    o = attention_reference(q, k, v, causal=causal,
+                                            dropout_rate=0.3,
+                                            dropout_seed=77)
+                else:
+                    o = fused_attention(q, k, v, causal=causal,
+                                        dropout_rate=0.3,
+                                        dropout_rng=77,
+                                        implementation=impl)
+                return jnp.sum(o * o)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        for g, w, name in zip(f("pallas_interpret"), f("xla"), "qkv"):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=5e-4, atol=5e-4,
+                                       err_msg=name)
+
+    def test_gqa_bias_dropout_combined(self, rng):
+        q, k, v = _qkv(rng, sq=128, sk=128, h=4, hk=2)
+        bias = jnp.asarray(rng.normal(size=(1, 4, 1, 128)), jnp.float32)
+
+        def f(impl):
+            def loss(q, k, v):
+                if impl == "xla":
+                    o = attention_reference(q, k, v, bias=bias,
+                                            dropout_rate=0.1,
+                                            dropout_seed=5)
+                else:
+                    o = fused_attention(q, k, v, bias=bias,
+                                        dropout_rate=0.1, dropout_rng=5,
+                                        implementation=impl)
+                return jnp.sum(o * o)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        for g, w in zip(f("pallas_interpret"), f("xla")):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=5e-4, atol=5e-4)
+
+    def test_keep_fraction_and_seed_sensitivity(self, rng):
+        from apex_tpu.ops.attention import dropout_keep_mask
+        m = dropout_keep_mask(42, 4, 8, 128, 128, 0.3)
+        frac = float(jnp.mean(m.astype(jnp.float32)))
+        assert abs(frac - 0.7) < 0.01, frac
+        m2 = dropout_keep_mask(43, 4, 8, 128, 128, 0.3)
+        assert not bool(jnp.array_equal(m, m2))
+
+    def test_mlm_seed_from_prng_key(self, rng):
+        q, k, v = _qkv(rng, sq=128, sk=128)
+        o = fused_attention(q, k, v, dropout_rate=0.2,
+                            dropout_rng=jax.random.PRNGKey(3),
+                            implementation="pallas_interpret")
+        assert o.shape == q.shape
+        assert bool(jnp.all(jnp.isfinite(o.astype(jnp.float32))))
 
 
 class TestMultiheadAttnModules:
