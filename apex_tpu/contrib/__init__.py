@@ -6,8 +6,11 @@ Inventory vs the reference (SURVEY.md §2.7):
   (:mod:`apex_tpu.ops.attention`, :mod:`apex_tpu.contrib.fmha`).
 - ``xentropy`` — memory-saving cross entropy (:mod:`apex_tpu.ops.xentropy`).
 - ``layer_norm`` (FastLayerNorm) — same Pallas LN as
-  :mod:`apex_tpu.ops.layer_norm` (autotuned block sizes subsume the
-  reference's per-hidden-size template specializations).
+  :mod:`apex_tpu.ops.layer_norm`; block sizes come from a VMEM-budget
+  heuristic, overridable per hidden size by the measured
+  sweep-and-cache autotuner (:mod:`apex_tpu.ops.autotune` — the
+  analogue of the reference's per-hidden-size template
+  specializations, measured instead of hand-instantiated).
 - ``group_norm`` / ``group_norm_v2`` — :mod:`apex_tpu.ops.group_norm`.
 - ``groupbn`` / ``cudnn_gbn`` — :mod:`apex_tpu.contrib.groupbn`.
 - ``optimizers.distributed_fused_adam/lamb`` —

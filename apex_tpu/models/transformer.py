@@ -72,6 +72,9 @@ class TransformerConfig:
     # (e.g. "save_only:attn_out" skips recomputing attention in bwd for
     # b·s·h bf16 per layer of memory).
     remat_policy: str = "nothing_saveable"
+    # with remat=True and unrolled layers: every k-th layer skips remat
+    # entirely (keeps activations, no backward recompute) — 0 disables
+    remat_skip_every: int = 0
     # flash-attention kernel tile sizes (isolated-op sweeps can mislead:
     # in the full rematted model 512/512 measures fastest at s=512)
     attention_block_q: int = 512
@@ -278,12 +281,20 @@ class ParallelTransformer(nn.Module):
             )
             x, _ = stack(cfg, deterministic, name="layers")(x, mask_bias)
         else:
-            layer_cls = ParallelTransformerLayer
+            remat_cls = ParallelTransformerLayer
             if cfg.remat:
-                layer_cls = nn.remat(
-                    layer_cls, prevent_cse=False,
+                remat_cls = nn.remat(
+                    ParallelTransformerLayer, prevent_cse=False,
                     policy=_remat_policy(cfg.remat_policy))
             for i in range(cfg.num_layers):
+                # remat_skip_every=k: every k-th layer keeps its
+                # activations (no recompute) — trades ~150 MB/layer of
+                # HBM for one layer-forward less of backward compute;
+                # the memory/FLOPs dial full remat doesn't have
+                skip = (cfg.remat_skip_every
+                        and i % cfg.remat_skip_every == 0)
+                layer_cls = (ParallelTransformerLayer if skip
+                             else remat_cls)
                 x = layer_cls(cfg, name=f"layer_{i}")(
                     x, mask_bias=mask_bias, deterministic=deterministic)
         return x

@@ -49,10 +49,21 @@ def resolve_impl(implementation: Optional[str], *,
     return impl
 
 
-def pick_block_rows(n_rows: int, width: int) -> int:
+def pick_block_rows(n_rows: int, width: int, *,
+                    op: Optional[str] = None, dtype=None) -> int:
     """Rows per grid step for row-wise kernels (LN/softmax): keep the
     fp32 x-block ≲ 2 MB of VMEM, ≥ 8 rows, multiple of 8 (fp32 sublane).
+
+    When ``op`` is given and :mod:`apex_tpu.ops.autotune` has a measured
+    entry for (device, op, width, dtype), the measured block size takes
+    precedence over the heuristic.
     """
+    if op is not None:
+        from apex_tpu.ops import autotune
+        hit = autotune.cached_block_rows(op, width, str(dtype))
+        if hit:
+            br = max(8, min(hit, max(8, n_rows)))
+            return max(8, (br // 8) * 8)   # fp32 sublane alignment
     budget = (2 * 1024 * 1024) // max(1, width * 4)
     br = max(8, min(256, budget))
     br = (br // 8) * 8

@@ -71,7 +71,7 @@ def _drop_threshold(rate: float) -> int:
     return min(int(rate * 4294967296.0), 4294967295)
 
 
-def _keep_from_counters(seed_u32, lane_u32, q_pos, k_pos, sk, rate):
+def _keep_from_counters(seed_u32, lane_u32, q_pos, k_pos, rate):
     """Boolean keep-mask from integer position counters (any shape).
 
     ``seed_u32``/``lane_u32`` scalars (or broadcastable), ``q_pos`` /
@@ -81,7 +81,6 @@ def _keep_from_counters(seed_u32, lane_u32, q_pos, k_pos, sk, rate):
     mask rows at long context; here ``q -> fmix32(q*C + h)`` is a
     bijection on uint32, so distinct (q, k) pairs never collide by
     construction at any sequence length."""
-    del sk  # no longer part of the counter (wraps at long context)
     h = seed_u32 ^ (lane_u32 * jnp.uint32(0x9E3779B9))
     row = _fmix32(q_pos.astype(jnp.uint32) * jnp.uint32(0x9E3779B9) + h)
     x = _fmix32(row ^ (k_pos.astype(jnp.uint32)
@@ -89,13 +88,13 @@ def _keep_from_counters(seed_u32, lane_u32, q_pos, k_pos, sk, rate):
     return x >= jnp.uint32(_drop_threshold(rate))
 
 
-def _dropout_keep_tile(seed_ref, lane, i, j, bq, bk, sk, rate):
+def _dropout_keep_tile(seed_ref, lane, i, j, bq, bk, rate):
     """(bq, bk) keep-mask for grid tile (lane, i, j) — in-kernel form."""
     q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     seed = seed_ref[0].astype(jnp.uint32)
     return _keep_from_counters(seed, jnp.uint32(lane), q_pos, k_pos,
-                               sk, rate)
+                               rate)
 
 
 def dropout_keep_mask(seed, b, h, sq, sk, rate):
@@ -110,7 +109,7 @@ def dropout_keep_mask(seed, b, h, sq, sk, rate):
         jnp.asarray(0 if seed is None else seed).astype(jnp.uint32),
         lane[:, :, None, None],
         q_pos[None, None, :, None], k_pos[None, None, None, :],
-        sk, rate)
+        rate)
     return keep
 
 
@@ -246,7 +245,7 @@ def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         if rate > 0.0:
             keep = _dropout_keep_tile(seed_ref, lane, i, j, bq, bk,
-                                      sk, rate)
+                                      rate)
             p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
@@ -393,7 +392,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
             # dS = P ∘ (D∘dP - delta): same mask as the forward tile;
             # delta = rowsum(dO·O) already contains the dropout factor
             keep = _dropout_keep_tile(seed_ref, lane, i, j, bq, bk,
-                                      sk, rate)
+                                      rate)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - delta) * scale
         acc_ref[:] += jax.lax.dot_general(
@@ -439,7 +438,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
         p = _zero_dead(s, jnp.exp(s - lse), causal, has_bias)
         if rate > 0.0:
             keep = _dropout_keep_tile(seed_ref, lane, i, j, bq, bk,
-                                      sk, rate)
+                                      rate)
             inv = 1.0 / (1.0 - rate)
             pd = jnp.where(keep, p * inv, 0.0)     # dropped probs
         else:
@@ -693,6 +692,11 @@ def fused_attention(q, k, v, *, causal: bool = False,
     bk = _pick_block(sk, block_k)
     kvb, bias_mode = _normalize_bias(bias, b, h, sq, sk)
     rate = float(dropout_rate)
+    if rate > 0.0 and dropout_rng is None:
+        raise ValueError(
+            "fused_attention: dropout_rate > 0 requires dropout_rng "
+            "(a JAX PRNG key or integer seed) — a silent constant "
+            "seed would drop the same positions every step")
     seed = _derive_seed(dropout_rng) if rate > 0.0 else None
     pallas_ok = (
         (bias is None or kvb is not None)

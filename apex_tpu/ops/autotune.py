@@ -1,0 +1,200 @@
+"""Sweep-and-cache block-size autotuner for the row-wise Pallas kernels.
+
+The reference's FastLayerNorm ships hand-written template
+specializations per hidden size (``csrc/layer_norm/`` instantiates a
+kernel per {768, 1024, 2048, ...}).  The TPU analogue: the block-rows
+parameter of the row-wise kernels (LN/RMSNorm/softmax) defaults to a
+VMEM-budget heuristic (:func:`apex_tpu.ops._dispatch.pick_block_rows`),
+and this module can *measure* the best value per (backend, width,
+dtype) and cache it — the measured table then takes precedence over
+the heuristic.
+
+Usage (offline, on the target chip)::
+
+    python -m apex_tpu.ops.autotune --widths 1024 4096 --rows 8192
+
+or programmatically::
+
+    from apex_tpu.ops import autotune
+    autotune.tune_layer_norm(n_rows=8192, width=1024)
+
+The cache persists to ``APEX_TPU_AUTOTUNE_CACHE`` (default
+``~/.cache/apex_tpu/autotune.json``) keyed by backend+device kind, so
+one sweep serves all subsequent processes on the same hardware.
+Timing uses a host-transfer sync (``device_get`` of a dependent
+scalar): on tunneled backends ``block_until_ready`` returns at
+dispatch and would measure nothing (see ``bench.py::_sync``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+from typing import Dict, Iterable, Optional
+
+__all__ = ["cached_block_rows", "tune_layer_norm", "tune_softmax",
+           "clear_cache"]
+
+_CACHE: Optional[Dict[str, int]] = None
+
+
+def _cache_path() -> pathlib.Path:
+    return pathlib.Path(os.environ.get(
+        "APEX_TPU_AUTOTUNE_CACHE",
+        os.path.expanduser("~/.cache/apex_tpu/autotune.json")))
+
+
+def _device_key() -> str:
+    import jax
+
+    dev = jax.devices()[0]
+    return f"{jax.default_backend()}:{getattr(dev, 'device_kind', '?')}"
+
+
+def _load() -> Dict[str, int]:
+    global _CACHE
+    if _CACHE is None:
+        try:
+            _CACHE = json.loads(_cache_path().read_text())
+        except (OSError, ValueError):
+            _CACHE = {}
+    return _CACHE
+
+
+def _store(key: str, value: int) -> None:
+    cache = _load()
+    cache[key] = value
+    path = _cache_path()
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(cache, indent=2, sort_keys=True))
+    except OSError:
+        pass  # read-only FS: keep the in-memory entry
+
+
+def _key(op: str, width: int, dtype) -> str:
+    return f"{_device_key()}/{op}/w{width}/{dtype}"
+
+
+def cached_block_rows(op: str, width: int, dtype) -> Optional[int]:
+    """Measured best block-rows for ``op`` at ``width``, or None if
+    this (device, op, width, dtype) was never tuned."""
+    return _load().get(_key(op, width, dtype))
+
+
+def clear_cache() -> None:
+    """Drop the in-memory cache (tests; the file is left alone)."""
+    global _CACHE
+    _CACHE = None
+
+
+def _sync(x):
+    import jax
+
+    jax.device_get(x.ravel()[0])
+
+
+def _time_call(fn, *args, iters: int = 10, warmup: int = 2) -> float:
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _tune(op: str, build_fn, n_rows: int, width: int, dtype,
+          candidates: Iterable[int]) -> int:
+    """Time ``build_fn(block_rows)`` over the candidates, cache and
+    return the winner."""
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(dtype)
+    best, best_dt = None, float("inf")
+    for br in candidates:
+        if br > n_rows or br % 8:
+            continue
+        try:
+            fn, args = build_fn(br)
+            dt = _time_call(fn, *args)
+        except Exception:
+            continue
+        if dt < best_dt:
+            best, best_dt = br, dt
+    if best is not None:
+        _store(_key(op, width, str(dtype)), best)
+    return best
+
+
+_DEFAULT_CANDIDATES = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def tune_layer_norm(n_rows: int = 8192, width: int = 1024,
+                    dtype="bfloat16",
+                    candidates: Iterable[int] = _DEFAULT_CANDIDATES) -> int:
+    """Sweep block-rows for the fused LN forward at (n_rows, width)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops import layer_norm as _ln
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (n_rows, width),
+                          jnp.dtype(dtype))
+    w2 = jnp.ones((1, width), jnp.float32)
+    b2 = jnp.zeros((1, width), jnp.float32)
+
+    def build(br):
+        fn = jax.jit(lambda x: _ln._run_ln_fwd(
+            x, w2, b2, 1e-5, False, False, block_rows=br)[0])
+        return fn, (x,)
+
+    return _tune("layer_norm", build, n_rows, width, str(jnp.dtype(dtype)),
+                 candidates)
+
+
+def tune_softmax(n_rows: int = 8192, width: int = 512,
+                 dtype="bfloat16",
+                 candidates: Iterable[int] = _DEFAULT_CANDIDATES) -> int:
+    """Sweep block-rows for the fused scale-mask-softmax."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops import softmax as _sm
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (n_rows, width),
+                          jnp.dtype(dtype))
+
+    def build(br):
+        fn = jax.jit(lambda x: _sm._run_softmax_fwd(
+            x, None, 1.0, False, n_rows, width, False, block_rows=br))
+        return fn, (x,)
+
+    return _tune("softmax", build, n_rows, width, str(jnp.dtype(dtype)),
+                 candidates)
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--widths", type=int, nargs="+", default=[1024])
+    p.add_argument("--rows", type=int, default=8192)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--ops", nargs="+", default=["layer_norm", "softmax"],
+                   choices=["layer_norm", "softmax"])
+    args = p.parse_args(argv)
+    for width in args.widths:
+        for op in args.ops:
+            tune = {"layer_norm": tune_layer_norm,
+                    "softmax": tune_softmax}[op]
+            best = tune(n_rows=args.rows, width=width, dtype=args.dtype)
+            print(f"{op} w={width}: best block_rows={best} "
+                  f"(cache: {_cache_path()})")
+
+
+if __name__ == "__main__":
+    main()

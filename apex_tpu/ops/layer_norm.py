@@ -110,9 +110,10 @@ def _ln_bwd_dx_kernel(dy_ref, x_ref, w_ref, mu_ref, rs_ref, dx_ref, *,
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
 
-def _run_ln_fwd(x2d, w2d, b2d, eps, rms, interpret):
+def _run_ln_fwd(x2d, w2d, b2d, eps, rms, interpret, block_rows=None):
     n, h = x2d.shape
-    br = pick_block_rows(n, h)
+    br = block_rows or pick_block_rows(n, h, op="layer_norm",
+                                       dtype=x2d.dtype)
     grid = (pl.cdiv(n, br),)
     kernel = functools.partial(_ln_fwd_kernel, eps=eps, rms=rms)
     in_specs = [
@@ -156,7 +157,7 @@ def _ln_fwd_kernel_nobias(x_ref, w_ref, y_ref, mu_ref, rs_ref, *,
 
 def _run_ln_bwd_dx(dy2d, x2d, w2d, mu, rstd, rms, interpret):
     n, h = x2d.shape
-    br = pick_block_rows(n, h)
+    br = pick_block_rows(n, h, op="layer_norm", dtype=x2d.dtype)
     grid = (pl.cdiv(n, br),)
     kernel = functools.partial(_ln_bwd_dx_kernel, rms=rms)
     dx = pl.pallas_call(

@@ -89,9 +89,11 @@ def _softmax_bwd_kernel(dy_ref, y_ref, dx_ref, *, scale):
     dx_ref[:] = ((dy - inner) * y * scale).astype(dx_ref.dtype)
 
 
-def _run_softmax_fwd(x2d, mask2d, scale, causal, sq, sk, interpret):
+def _run_softmax_fwd(x2d, mask2d, scale, causal, sq, sk, interpret,
+                     block_rows=None):
     n, w = x2d.shape
-    br = pick_block_rows(n, w)
+    br = block_rows or pick_block_rows(n, w, op="softmax",
+                                       dtype=x2d.dtype)
     grid = (pl.cdiv(n, br),)
     has_mask = mask2d is not None
     if has_mask:
@@ -128,7 +130,7 @@ def _run_softmax_fwd(x2d, mask2d, scale, causal, sq, sk, interpret):
 
 def _run_softmax_bwd(dy2d, y2d, scale, interpret):
     n, w = y2d.shape
-    br = pick_block_rows(n, w)
+    br = pick_block_rows(n, w, op="softmax", dtype=y2d.dtype)
     grid = (pl.cdiv(n, br),)
     kernel = functools.partial(_softmax_bwd_kernel, scale=scale)
     return pl.pallas_call(

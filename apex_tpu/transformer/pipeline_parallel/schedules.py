@@ -9,22 +9,25 @@ Reference: ``apex/transformer/pipeline_parallel/schedules/`` —
 TPU design — *the schedule is a program, not an event loop*.  Two
 complementary mechanisms:
 
-- :func:`spmd_pipeline_1f1b` (used by the reference-named 1F1B driver)
-  hand-writes the one-forward-one-backward tick table as a single
-  ``lax.scan`` inside ``shard_map`` over ``pipe``: each tick runs one
-  forward unit and one backward unit (``jax.vjp`` recompute +
-  transpose), activations ride a forward ``ppermute`` ring, cotangents
-  a reverse ring, and live activations are bounded by a ``2*pp``-slot
-  stash of stage *inputs* — O(pp), flat in M, exactly the memory shape
-  that is 1F1B's reason to exist.  Dead warmup/cooldown units are
-  skipped with ``lax.cond``, not computed-and-masked.
+- :func:`spmd_pipeline_1f1b` / :func:`spmd_pipeline_1f1b_interleaved`
+  (used by the reference-named drivers) hand-write the
+  one-forward-one-backward tick table as a single ``lax.scan`` inside
+  ``shard_map`` over ``pipe``: each tick runs one forward unit and one
+  backward unit (``jax.vjp`` recompute + transpose), activations ride
+  a forward ``ppermute`` ring, cotangents a reverse ring, and live
+  activations are bounded by a ``2*pp``(·V)-slot stash of stage
+  *inputs* — O(pp·V), flat in M, exactly the memory shape that is
+  1F1B's reason to exist.  Dead warmup/cooldown units are skipped with
+  ``lax.cond``, not computed-and-masked; the non-interleaved form also
+  streams cyclically-sharded microbatches to rank 0 through a feed
+  ring, so input memory is O(M/pp) per rank.
 - :func:`spmd_pipeline` / :func:`spmd_pipeline_interleaved` are
   *autodiff-able forward* pipelines (scan + ppermute): JAX transposes
   them into the reverse pipeline, so they compose with outer
   ``value_and_grad`` (e.g. a model with embedding/head outside the
   pipelined region).  Convenient, but the transposed scan stashes all
   ``M + pp - 1`` tick outputs — O(M) activation memory; prefer the
-  1F1B driver for large M.
+  1F1B drivers for large M.
 
 The pipeline spans the homogeneous transformer stack (stage params are
 stacked along a leading ``pp`` axis and split by ``shard_map``);
@@ -52,6 +55,7 @@ from apex_tpu.transformer.pipeline_parallel.p2p import (
 __all__ = [
     "spmd_pipeline",
     "spmd_pipeline_1f1b",
+    "spmd_pipeline_1f1b_interleaved",
     "spmd_pipeline_interleaved",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
@@ -336,6 +340,197 @@ def spmd_pipeline_1f1b(
 
 
 # --------------------------------------------------------------------- #
+# true 1F1B, interleaved (virtual pipeline) variant
+# --------------------------------------------------------------------- #
+def spmd_pipeline_1f1b_interleaved(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Any,
+    microbatches: jnp.ndarray,
+    *,
+    axis: str = PIPE_AXIS,
+):
+    """Interleaved (virtual-pipeline) one-forward-one-backward schedule
+    computing ``(loss, grads)`` with O(pp·V) live activations.
+
+    Reference: ``fwd_bwd_pipelining_with_interleaving.py`` — V model
+    chunks per rank (global stage ``c*pp + r``), each microbatch laps
+    the ring V times, bubble ``(pp-1)/(V·M)``; 1F1B keeps at most
+    O(pp·V) microbatch activations live regardless of M.
+
+    Tick table (one ``lax.scan``): forward item ``if = t - rank`` with
+    ``if = g·V·pp + c·pp + j`` (microbatch ``m = g·pp + j``, lap ``c``)
+    — the circular enumeration of :func:`spmd_pipeline_interleaved`,
+    whose ppermute wrap link is the lap hand-off.  Backward items run
+    in the order ``ρ(i) = g·V·pp + (V-1-c)·pp + j`` (groups in arrival
+    order, laps reversed) at tick ``τ(i, r) = V·pp + ρ(i) +
+    (pp-1-r)``: within a lap the cotangent steps down the reverse ring
+    one rank per tick, and the lap boundary lines up exactly —
+    ``τ(i+pp, 0) = τ(i, pp-1) - 1``, so lap ``c``'s last-rank backward
+    consumes the cotangent lap ``c+1``'s rank-0 backward sent through
+    the reverse wrap link one tick earlier.  Setting V=1 recovers the
+    plain 1F1B table (``τ = pp + m + pp-1-r``).
+
+    The last rank computes each microbatch's loss cotangent right
+    after its final-lap forward (tick ``V·pp + ρ(i) - 1``) and feeds
+    itself one tick later, exactly like the non-interleaved schedule.
+    Stage inputs live in a ``2·V·pp``-slot stash (an item's slot is
+    freed after ``≤ 2·V·pp - 1`` ticks, its maximum fwd→bwd distance),
+    so memory is flat in M.  Requires ``M % pp == 0`` (the reference's
+    interleaved constraint, enforced by the driver).
+
+    ``stage_params`` per rank: leading ``(V, 1, ...)`` axes — the
+    ``(V, pp, ...)`` global stack split over ``axis`` on dim 1 — or
+    0-d replicated scalars.  Returns ``(loss_local, grads_local)`` as
+    in :func:`spmd_pipeline_1f1b`, with ``grads_local`` carrying the
+    chunk axis ``(V, ...)``.
+    """
+    pp = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    num_micro = microbatches.shape[0]
+    if num_micro % pp:
+        raise ValueError(
+            f"interleaved schedule requires num_microbatches "
+            f"({num_micro}) % pipeline size ({pp}) == 0 "
+            f"(reference constraint)")
+
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.ndim == 1 or (leaf.ndim >= 2 and leaf.shape[1] != 1):
+            raise ValueError(
+                f"stage_params leaves must be (V, pp, ...) stacks with "
+                f"dim 1 split over '{axis}' to local size 1, or 0-d "
+                f"replicated scalars; got local shape {leaf.shape} — "
+                f"pass params_spec=P(None, '{axis}', ...)")
+    params_local = jax.tree.map(
+        lambda a: a[:, 0] if a.ndim >= 2 else a, stage_params)
+    stacked = [l for l in jax.tree.leaves(params_local) if l.ndim]
+    if not stacked:
+        raise ValueError("stage_params has no stacked (V, pp, ...) leaf")
+    v = stacked[0].shape[0]
+
+    n_items = num_micro * v
+    # last backward: ρ = n_items-1 on rank 0 → t = v·pp + n_items-1 + pp-1
+    n_ticks = v * pp + n_items + pp - 1
+    n_slots = 2 * v * pp
+
+    mb_shape = microbatches[0]
+
+    def varying(x):
+        try:
+            return lax.pcast(x, (axis,), to="varying")
+        except ValueError:
+            return x
+
+    def chunk_params(c):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(
+                a, c, axis=0, keepdims=False) if a.ndim else a,
+            params_local)
+
+    def tick(carry, t):
+        fwd_x, bwd_ct, pending_ct, stash, loss_acc, grad_acc = carry
+
+        # ---- forward unit: item if = t - rank ----
+        i_f = t - rank
+        valid_f = (i_f >= 0) & (i_f < n_items)
+        iv = jnp.clip(i_f, 0, n_items - 1)
+        g_f = iv // (v * pp)
+        rem = iv % (v * pp)
+        c_f = rem // pp
+        j_f = rem % pp
+        m_f = g_f * pp + j_f
+        mb = lax.dynamic_index_in_dim(microbatches, m_f, axis=0,
+                                      keepdims=False)
+        # rank 0 lap 0 injects fresh microbatches; every other (rank,
+        # lap) consumes the fwd-ring hand-off (wrap link = lap hand-off)
+        x = jnp.where((rank == 0) & (c_f == 0), mb, fwd_x)
+        y = lax.cond(
+            valid_f,
+            lambda a: varying(stage_fn(chunk_params(c_f), a)),
+            lambda a: varying(jnp.zeros_like(a)), x)
+        slot_f = iv % n_slots
+        new_stash = lax.dynamic_update_index_in_dim(
+            stash, x.astype(stash.dtype), slot_f, axis=0)
+        stash = jnp.where(valid_f, new_stash, stash)
+
+        # ---- loss + output-cotangent on the last rank, last lap ----
+        def loss_and_ct(y):
+            lval, pull = jax.vjp(lambda yy: loss_fn(yy, m_f), y)
+            seed = varying(
+                (jnp.float32(1) / num_micro).astype(lval.dtype))
+            (ct,) = pull(seed)
+            return varying(lval.astype(jnp.float32)), varying(ct)
+
+        is_last = rank == pp - 1
+        fire_loss = valid_f & is_last & (c_f == v - 1)
+        lval, maybe_pending = lax.cond(
+            fire_loss, loss_and_ct,
+            lambda y: (varying(jnp.zeros((), jnp.float32)),
+                       varying(jnp.zeros_like(y))), y)
+        # only overwrite the pending slot when a loss actually fired —
+        # it is consumed exactly one tick later, before the next fire
+        new_pending = jnp.where(fire_loss, maybe_pending, pending_ct)
+        loss_acc = loss_acc + lval
+
+        # ---- backward unit: ρ = t - v·pp - (pp-1-rank) ----
+        rho = t - v * pp - (pp - 1 - rank)
+        valid_b = (rho >= 0) & (rho < n_items)
+        rv = jnp.clip(rho, 0, n_items - 1)
+        g_b = rv // (v * pp)
+        remb = rv % (v * pp)
+        c_b = (v - 1) - remb // pp          # laps reversed in backward
+        j_b = remb % pp
+        i_b = g_b * v * pp + c_b * pp + j_b
+        x_saved = lax.dynamic_index_in_dim(
+            stash, i_b % n_slots, axis=0, keepdims=False)
+        # cotangent source: last rank on the final lap feeds itself the
+        # pending loss cotangent (computed last tick); everything else
+        # reads the reverse ring (whose wrap link 0 -> pp-1 is the
+        # backward lap hand-off)
+        ct_in = jnp.where(is_last & (c_b == v - 1), pending_ct, bwd_ct)
+
+        def run_bwd(operands):
+            x_s, ct = operands
+            cp = chunk_params(c_b)
+            _, pull = jax.vjp(lambda p, xx: stage_fn(p, xx), cp, x_s)
+            gp, gx = pull(ct)
+            return jax.tree.map(varying, (gp, gx))
+
+        gp, gx = lax.cond(
+            valid_b, run_bwd,
+            lambda operands: jax.tree.map(varying, (
+                jax.tree.map(jnp.zeros_like, chunk_params(0)),
+                jnp.zeros_like(operands[0]))),
+            (x_saved, ct_in))
+        # scatter-accumulate this chunk's parameter grads at index c_b
+        grad_acc = jax.tree.map(
+            lambda acc, g: lax.dynamic_update_index_in_dim(
+                acc,
+                lax.dynamic_index_in_dim(acc, c_b, 0, keepdims=False)
+                + g, c_b, axis=0) if acc.ndim else acc + g,
+            grad_acc, gp)
+
+        # ---- rings ----
+        fwd_x = send_forward_recv_forward(y, axis=axis)
+        bwd_ct = send_backward_recv_backward(gx, axis=axis)
+        return (fwd_x, bwd_ct, new_pending, stash, loss_acc,
+                grad_acc), None
+
+    init = (
+        varying(jnp.zeros_like(mb_shape)),                  # fwd ring
+        varying(jnp.zeros_like(mb_shape)),                  # bwd ring
+        varying(jnp.zeros_like(mb_shape)),                  # pending ct
+        varying(jnp.zeros((n_slots,) + mb_shape.shape,
+                          mb_shape.dtype)),                 # stash
+        varying(jnp.zeros((), jnp.float32)),                # loss acc
+        jax.tree.map(jnp.zeros_like, params_local),          # grad acc
+    )
+    carry, _ = lax.scan(tick, init, jnp.arange(n_ticks))
+    loss_acc, grad_acc = carry[-2], carry[-1]
+    return loss_acc, grad_acc
+
+
+# --------------------------------------------------------------------- #
 # interleaved (virtual pipeline) variant — the circular schedule
 # --------------------------------------------------------------------- #
 def spmd_pipeline_interleaved(
@@ -606,12 +801,36 @@ def forward_backward_pipelining_with_interleaving(
     ``c`` on rank ``r`` implements global stage ``c*pp + r`` — so each
     microbatch makes ``V`` laps around the ring.  Requires
     ``num_microbatches % pp == 0``.
+
+    Drives :func:`spmd_pipeline_1f1b_interleaved` — the explicit
+    interleaved 1F1B tick table with O(pp·V) live activations —
+    rather than autodiff over the circular forward scan (which would
+    stash all ``M·V + pp - 1`` tick outputs).  ``remat`` is accepted
+    for API stability but has no effect: each backward unit recomputes
+    its stage interior from the stashed input by construction.
     """
-    return _pipelined_value_and_grad(
-        spmd_pipeline_interleaved, lambda ax: P(None, ax),
-        stage_fn, loss_fn, stage_params, batch, mesh=mesh,
-        num_microbatches=num_microbatches, axis=axis, remat=remat,
-        params_spec=params_spec)
+    del remat  # remat-by-construction (see docstring)
+    m = num_microbatches or get_num_microbatches()
+    mbs = batch.reshape(m, batch.shape[0] // m, *batch.shape[1:])
+    pspec = params_spec if params_spec is not None else P(None, axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=(P(), pspec),
+        axis_names={axis})
+    def run(params_local, mbs_local):
+        loss_local, grads_local = spmd_pipeline_1f1b_interleaved(
+            stage_fn, loss_fn, params_local, mbs_local, axis=axis)
+        loss = lax.psum(loss_local, axis) / m
+        # restore the stripped split-pp axis for the out_spec: local
+        # grads are (V, ...); the spec expects (V, 1, ...).  0-d
+        # replicated scalars psum every stage's contribution.
+        grads = jax.tree.map(
+            lambda g, a: g[:, None] if a.ndim else lax.psum(g, axis),
+            grads_local, params_local)
+        return loss, grads
+
+    return run(stage_params, mbs)
 
 
 def get_forward_backward_func(

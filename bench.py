@@ -57,7 +57,10 @@ def _build(cfg_kw, opt_level, half_dtype, fused):
     cfg = BertConfig.bert_large(**cfg_kw) if not int(
         os.environ.get("BENCH_TINY", "0")) else BertConfig.tiny(**cfg_kw)
     model = BertModel(cfg)
-    tx = fused_adam(1e-4) if fused else optax.adam(1e-4)
+    moment_dtype = {"bf16": jnp.bfloat16, "fp32": jnp.float32}[
+        os.environ.get("BENCH_MOMENT_DTYPE", "fp32")]
+    tx = (fused_adam(1e-4, moment_dtype=moment_dtype) if fused
+          else optax.adam(1e-4))
 
     b = int(os.environ.get("BENCH_BATCH", "16"))
     s = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_seq_len, 512))))
@@ -84,13 +87,42 @@ def _build(cfg_kw, opt_level, half_dtype, fused):
 
     # donate the state: in-place param/opt-state updates (~2% step time,
     # and frees a full copy of the fp32 masters + adam moments in HBM)
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(state, ids, positions, mlm_labels):
-        grads, loss = jax.grad(
-            lambda p_: loss_of(state, p_, ids, positions, mlm_labels),
-            has_aux=True)(state.params)
-        new_state, finite = state.apply_gradients(grads=grads)
-        return new_state, loss, finite
+    accum = int(os.environ.get("BENCH_ACCUM", "1"))
+    if accum > 1:
+        # gradient accumulation over microbatches (one optimizer step):
+        # lets no-remat fit in HBM at small per-microbatch size —
+        # trades the remat recompute FLOPs for saved activations
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, ids, positions, mlm_labels):
+            mbs = jax.tree.map(
+                lambda x: x.reshape(accum, x.shape[0] // accum,
+                                    *x.shape[1:]),
+                (ids, positions, mlm_labels))
+
+            def body(acc, mb):
+                g, l = jax.grad(
+                    lambda p_: loss_of(state, p_, *mb),
+                    has_aux=True)(state.params)
+                acc_g, acc_l = acc
+                return (jax.tree.map(jnp.add, acc_g, g),
+                        acc_l + l), None
+
+            zero = (jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params),
+                jnp.zeros((), jnp.float32))
+            (gsum, lsum), _ = jax.lax.scan(body, zero, mbs)
+            grads = jax.tree.map(lambda g: g / accum, gsum)
+            new_state, finite = state.apply_gradients(grads=grads)
+            return new_state, lsum / accum, finite
+    else:
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, ids, positions, mlm_labels):
+            grads, loss = jax.grad(
+                lambda p_: loss_of(state, p_, ids, positions,
+                                   mlm_labels),
+                has_aux=True)(state.params)
+            new_state, finite = state.apply_gradients(grads=grads)
+            return new_state, loss, finite
 
     # breakdown probes: forward-only and forward+backward (no optimizer).
     # No donation — they leave the state alive for the full-step timing.

@@ -20,6 +20,11 @@ def main():
                                        "nothing_saveable"),
         "dtype": jnp.bfloat16,
     }
+    for knob in ("attention_block_q", "attention_block_k",
+                 "remat_skip_every"):
+        v = os.environ.get("BENCH_" + knob.upper())
+        if v:
+            cfg_kw[knob] = int(v)
     n_steps = int(os.environ.get("BENCH_STEPS", "20"))
     k_windows = max(1, int(os.environ.get("BENCH_WINDOWS", "2")))
     state, step, _probes, batch, b = bench._build(

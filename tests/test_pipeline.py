@@ -308,6 +308,35 @@ class TestInterleavedSchedule:
                 _stage_fn, lambda y, i: jnp.mean(y ** 2), stacked,
                 batch, mesh=mesh8, num_microbatches=3)
 
+    def test_memory_flat_in_microbatches_interleaved(self, rng, mesh8):
+        """Interleaved 1F1B contract: live activations O(pp·V), so the
+        compiled step's temp buffers stay flat as M grows 4 → 32 (the
+        autodiff circular scan would grow O(M·V))."""
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_with_interleaving)
+        pp = mesh8.shape[PIPE_AXIS]
+        stacked = _stacked_params_vpp(rng, 2, pp)
+
+        def loss_fn(y, idx):
+            return jnp.mean(y ** 2)
+
+        def temp_bytes(m):
+            f = jax.jit(
+                lambda p, b: forward_backward_pipelining_with_interleaving(
+                    _stage_fn, loss_fn, p, b, mesh=mesh8,
+                    num_microbatches=m))
+            lowered = f.lower(
+                jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    stacked),
+                jax.ShapeDtypeStruct((m * MB, SEQ, HID), jnp.float32))
+            stats = lowered.compile().memory_analysis()
+            assert stats is not None
+            return stats.temp_size_in_bytes
+
+        t4, t32 = temp_bytes(4), temp_bytes(32)
+        assert t32 <= 1.5 * t4 + 4096, (t4, t32)
+
     def test_dispatch(self):
         from apex_tpu.transformer.pipeline_parallel import (
             forward_backward_pipelining_with_interleaving)
