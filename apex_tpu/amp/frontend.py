@@ -43,6 +43,30 @@ def initialize(
     """
     import jax.numpy as jnp
 
+    # reference list form: amp.initialize([modelA, modelB], [optA, optB])
+    # returns one independently-scaled state per pair (the reference's
+    # multiple-models/optimizers mode; ``num_losses > 1`` ≙ each state's
+    # own DynamicLossScale — share one by
+    # ``state.replace(loss_scale_state=shared)`` if the reference's
+    # single-scaler behavior is wanted)
+    # exact type check: GradientTransformation is itself a NamedTuple
+    if type(tx) in (list, tuple):
+        fns = (apply_fn if type(apply_fn) in (list, tuple)
+               else [apply_fn] * len(tx))
+        if type(params) not in (list, tuple) or not (
+                len(fns) == len(params) == len(tx)):
+            raise ValueError(
+                f"list-form initialize needs a params list/tuple of "
+                f"matching length, got {len(fns)} apply_fns / "
+                f"{type(params).__name__} of {len(params)} params / "
+                f"{len(tx)} optimizers")
+        return [initialize(f, p, t, opt_level, half_dtype=half_dtype,
+                           loss_scale=loss_scale,
+                           keep_batchnorm_fp32=keep_batchnorm_fp32,
+                           master_weights=master_weights,
+                           **policy_overrides)
+                for f, p, t in zip(fns, params, tx)]
+
     overrides = dict(policy_overrides)
     if loss_scale != "__unset__":
         overrides["loss_scale"] = loss_scale

@@ -26,9 +26,15 @@ from apex_tpu.parallel.ring_attention import (
     ring_attention,
     ring_self_attention,
 )
+from apex_tpu.parallel.launch import (
+    init_distributed,
+    is_distributed,
+)
 from apex_tpu.optim import LARC
 
 __all__ = [
+    "init_distributed",
+    "is_distributed",
     "DistributedDataParallel", "replicate", "shard_batch",
     "all_reduce_mean_grads",
     "SyncBatchNorm", "sync_batch_norm_stats", "convert_syncbn_model",

@@ -1,0 +1,81 @@
+"""Multi-host bootstrap — the reference's launcher row, TPU-native.
+
+Reference: ``apex/parallel/multiproc.py`` (a tiny pre-``torchrun``
+process-per-GPU launcher) plus the ``torch.distributed.launch``
+conventions its examples assume (SURVEY.md §2.5).  On TPU there is no
+process-per-chip launcher to port: each *host* runs one process that
+owns all its local chips, and multi-host coordination is
+``jax.distributed.initialize`` — on Cloud TPU it autodetects the
+coordinator and process indices from the TPU metadata, so the common
+case is a single zero-argument call.
+
+:func:`init_distributed` wraps that with the reference-style
+environment conventions (``MASTER_ADDR``/``MASTER_PORT``/``RANK``/
+``WORLD_SIZE``, which ``apex.parallel.multiproc`` and
+``torch.distributed.launch`` both set) so migrated launch scripts work
+unchanged, and is a no-op on a single host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["init_distributed", "is_distributed"]
+
+_INITIALIZED = False
+
+
+def is_distributed() -> bool:
+    """True once :func:`init_distributed` has set up multi-host JAX."""
+    return _INITIALIZED
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> bool:
+    """Initialize multi-host JAX, reading reference-style env vars.
+
+    Resolution order for each field: explicit argument →
+    ``MASTER_ADDR:MASTER_PORT`` / ``WORLD_SIZE`` / ``RANK`` (the
+    conventions the reference's launcher and ``torch.distributed``
+    set) → autodetection by ``jax.distributed.initialize`` (Cloud TPU
+    metadata).  Returns True if a multi-host runtime was started,
+    False for the single-host no-op (``WORLD_SIZE`` absent or 1 and no
+    explicit arguments).
+
+    Call once, before any other JAX API touches the backend —
+    the same "first thing in main()" contract as
+    ``torch.distributed.init_process_group``.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    if coordinator_address is None:
+        addr = os.environ.get("MASTER_ADDR")
+        if addr:
+            port = os.environ.get("MASTER_PORT", "8476")
+            coordinator_address = f"{addr}:{port}"
+    if num_processes is None and "WORLD_SIZE" in os.environ:
+        num_processes = int(os.environ["WORLD_SIZE"])
+    if process_id is None and "RANK" in os.environ:
+        process_id = int(os.environ["RANK"])
+
+    if coordinator_address is None and num_processes in (None, 1):
+        # single host (no coordinator, world size absent or 1, e.g. a
+        # migrated script that only sets RANK=0): plain local JAX
+        return False
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    _INITIALIZED = True
+    return True

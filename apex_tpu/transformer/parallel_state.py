@@ -41,6 +41,7 @@ __all__ = [
     "is_pipeline_first_stage",
     "is_pipeline_last_stage",
     "get_virtual_pipeline_model_parallel_world_size",
+    "get_amax_reduction_axes",
 ]
 
 _VIRTUAL_PIPE_SIZE: Optional[int] = None
@@ -141,6 +142,17 @@ def is_pipeline_last_stage():
     """Traced predicate: pipe coordinate == pp - 1 (reference name)."""
     return (jax.lax.axis_index(PIPE_AXIS)
             == mesh_lib.mesh_axis_size(PIPE_AXIS) - 1)
+
+
+def get_amax_reduction_axes():
+    """Mesh axes over which FP8-style amax statistics reduce (reference:
+    the amax-reduction process groups newer ``parallel_state`` versions
+    build for FP8 training) — every model-parallel axis plus data, so a
+    ``lax.pmax`` over these axes reproduces the reference's global amax
+    all-reduce.  TPU v5 has no fp8 MXU path; this exists for API parity
+    and for int8/quantized-compression amax plumbing
+    (``apex_tpu.parallel.ddp`` int8 all-reduce)."""
+    return (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, CONTEXT_AXIS)
 
 
 # ------------------------- axis names -------------------------------- #

@@ -29,7 +29,8 @@ PAGES = {
     "ops": ["apex_tpu.ops.attention", "apex_tpu.ops.multihead_attn",
             "apex_tpu.ops.layer_norm", "apex_tpu.ops.softmax",
             "apex_tpu.ops.rope", "apex_tpu.ops.mlp",
-            "apex_tpu.ops.xentropy", "apex_tpu.ops.group_norm"],
+            "apex_tpu.ops.xentropy", "apex_tpu.ops.group_norm",
+            "apex_tpu.ops.autotune"],
     "optim": ["apex_tpu.optim.fused_adam", "apex_tpu.optim.fused_lamb",
               "apex_tpu.optim.fused_sgd", "apex_tpu.optim.fused_novograd",
               "apex_tpu.optim.fused_adagrad",
@@ -38,7 +39,8 @@ PAGES = {
               "apex_tpu.optim._multi_tensor"],
     "parallel": ["apex_tpu.parallel.ddp", "apex_tpu.parallel.sync_batchnorm",
                  "apex_tpu.parallel.ring_attention",
-                 "apex_tpu.parallel.distributed_optim"],
+                 "apex_tpu.parallel.distributed_optim",
+                 "apex_tpu.parallel.launch"],
     "transformer": ["apex_tpu.transformer.layers",
                     "apex_tpu.transformer.mappings",
                     "apex_tpu.transformer.cross_entropy",

