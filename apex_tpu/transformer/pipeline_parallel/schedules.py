@@ -349,6 +349,7 @@ def spmd_pipeline_1f1b_interleaved(
     microbatches: jnp.ndarray,
     *,
     axis: str = PIPE_AXIS,
+    microbatches_distributed: bool = False,
 ):
     """Interleaved (virtual-pipeline) one-forward-one-backward schedule
     computing ``(loss, grads)`` with O(pp·V) live activations.
@@ -384,10 +385,22 @@ def spmd_pipeline_1f1b_interleaved(
     0-d replicated scalars.  Returns ``(loss_local, grads_local)`` as
     in :func:`spmd_pipeline_1f1b`, with ``grads_local`` carrying the
     chunk axis ``(V, ...)``.
+
+    ``microbatches_distributed=True``: ``microbatches`` is the local
+    cyclic shard ``(M/pp, mb, ...)`` (rank ``r`` holds ``r::pp``) and a
+    feed ring streams each to rank 0 just in time — rank 0 consumes
+    lap-0 items at ticks ``t ≡ j (mod V·pp), j < pp``, so all ranks
+    inject their next local microbatch every ``V·pp`` ticks, the feed
+    shifts one hop toward rank 0 for the first ``pp`` ticks of each
+    window and idles the rest.  Per-rank input memory O(M/pp).
     """
     pp = lax.axis_size(axis)
     rank = lax.axis_index(axis)
-    num_micro = microbatches.shape[0]
+    if microbatches_distributed:
+        local_n = microbatches.shape[0]
+        num_micro = local_n * pp
+    else:
+        num_micro = microbatches.shape[0]
     if num_micro % pp:
         raise ValueError(
             f"interleaved schedule requires num_microbatches "
@@ -428,7 +441,8 @@ def spmd_pipeline_1f1b_interleaved(
             params_local)
 
     def tick(carry, t):
-        fwd_x, bwd_ct, pending_ct, stash, loss_acc, grad_acc = carry
+        (fwd_x, bwd_ct, pending_ct, feed, stash, loss_acc,
+         grad_acc) = carry
 
         # ---- forward unit: item if = t - rank ----
         i_f = t - rank
@@ -439,8 +453,14 @@ def spmd_pipeline_1f1b_interleaved(
         c_f = rem // pp
         j_f = rem % pp
         m_f = g_f * pp + j_f
-        mb = lax.dynamic_index_in_dim(microbatches, m_f, axis=0,
-                                      keepdims=False)
+        if microbatches_distributed:
+            # feed-ring invariant: when rank 0 runs a lap-0 item (tick
+            # t ≡ j mod V·pp, j < pp), its feed buffer holds exactly
+            # microbatch g·pp + j (see docstring)
+            mb = feed
+        else:
+            mb = lax.dynamic_index_in_dim(microbatches, m_f, axis=0,
+                                          keepdims=False)
         # rank 0 lap 0 injects fresh microbatches; every other (rank,
         # lap) consumes the fwd-ring hand-off (wrap link = lap hand-off)
         x = jnp.where((rank == 0) & (c_f == 0), mb, fwd_x)
@@ -513,13 +533,32 @@ def spmd_pipeline_1f1b_interleaved(
         # ---- rings ----
         fwd_x = send_forward_recv_forward(y, axis=axis)
         bwd_ct = send_backward_recv_backward(gx, axis=axis)
-        return (fwd_x, bwd_ct, new_pending, stash, loss_acc,
+        if microbatches_distributed:
+            # re-establish the feed invariant for tick t+1: inject the
+            # next local microbatch at each V·pp-tick window start,
+            # shift one hop toward rank 0 during the window's first pp
+            # ticks (the lap-0 consumption phase), idle the rest
+            tn = t + 1
+            win = tn % (v * pp)
+            local_next = lax.dynamic_index_in_dim(
+                microbatches,
+                jnp.clip(tn // (v * pp), 0, local_n - 1),
+                axis=0, keepdims=False)
+            shifted = lax.ppermute(
+                feed, axis, [(i, (i - 1) % pp) for i in range(pp)])
+            feed = jnp.where(
+                win == 0, local_next,
+                jnp.where(win < pp, shifted, feed))
+        return (fwd_x, bwd_ct, new_pending, feed, stash, loss_acc,
                 grad_acc), None
 
+    feed0 = (varying(microbatches[0]) if microbatches_distributed
+             else varying(jnp.zeros((), mb_shape.dtype)))
     init = (
         varying(jnp.zeros_like(mb_shape)),                  # fwd ring
         varying(jnp.zeros_like(mb_shape)),                  # bwd ring
         varying(jnp.zeros_like(mb_shape)),                  # pending ct
+        feed0,                                              # feed ring
         varying(jnp.zeros((n_slots,) + mb_shape.shape,
                           mb_shape.dtype)),                 # stash
         varying(jnp.zeros((), jnp.float32)),                # loss acc
@@ -708,6 +747,18 @@ def _pipelined_value_and_grad(
     return jax.value_and_grad(pipelined_loss)(stage_params, mbs)
 
 
+def _distribute_microbatches(mbs, m, mesh, axis):
+    """Cyclic microbatch sharding over the pipe ranks (rank r holds
+    ``r::pp``) for the feed-ring drivers: returns ``(mbs, mb_spec,
+    distributed)``; falls back to replicated when M %% pp != 0."""
+    pp_size = mesh.shape[axis]
+    if pp_size > 1 and m % pp_size == 0:
+        mbs = jnp.swapaxes(
+            mbs.reshape(m // pp_size, pp_size, *mbs.shape[1:]), 0, 1)
+        return mbs, P(axis), True
+    return mbs, P(), False
+
+
 def forward_backward_pipelining_without_interleaving(
     stage_fn: Callable,
     loss_fn: Callable,
@@ -742,18 +793,11 @@ def forward_backward_pipelining_without_interleaving(
     mbs = batch.reshape(m, batch.shape[0] // m, *batch.shape[1:])
     pspec = params_spec if params_spec is not None else P(axis)
 
-    # shard the microbatch axis over the pipe ranks (cyclic: rank r
-    # holds microbatches r::pp) so per-rank input memory is O(M/pp) —
-    # the feed ring inside spmd_pipeline_1f1b streams them to rank 0.
-    # M not divisible by pp falls back to the replicated form.
-    pp_size = mesh.shape[axis]
-    distributed = pp_size > 1 and m % pp_size == 0
-    if distributed:
-        mbs = jnp.swapaxes(
-            mbs.reshape(m // pp_size, pp_size, *mbs.shape[1:]), 0, 1)
-        mb_spec = P(axis)
-    else:
-        mb_spec = P()
+    # shard the microbatch axis over the pipe ranks (cyclic) so
+    # per-rank input memory is O(M/pp) — the feed ring inside
+    # spmd_pipeline_1f1b streams them to rank 0
+    mbs, mb_spec, distributed = _distribute_microbatches(
+        mbs, m, mesh, axis)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -814,13 +858,21 @@ def forward_backward_pipelining_with_interleaving(
     mbs = batch.reshape(m, batch.shape[0] // m, *batch.shape[1:])
     pspec = params_spec if params_spec is not None else P(None, axis)
 
+    # cyclic microbatch sharding + feed-ring streaming, as in the
+    # non-interleaved driver: per-rank input memory O(M/pp)
+    mbs, mb_spec, distributed = _distribute_microbatches(
+        mbs, m, mesh, axis)
+
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(pspec, P()), out_specs=(P(), pspec),
+        in_specs=(pspec, mb_spec), out_specs=(P(), pspec),
         axis_names={axis})
     def run(params_local, mbs_local):
+        if distributed:
+            mbs_local = mbs_local[0]     # strip the split pp dim
         loss_local, grads_local = spmd_pipeline_1f1b_interleaved(
-            stage_fn, loss_fn, params_local, mbs_local, axis=axis)
+            stage_fn, loss_fn, params_local, mbs_local, axis=axis,
+            microbatches_distributed=distributed)
         loss = lax.psum(loss_local, axis) / m
         # restore the stripped split-pp axis for the out_spec: local
         # grads are (V, ...); the spec expects (V, 1, ...).  0-d

@@ -365,6 +365,81 @@ def bench_bert_o1():
     _emit(out)
 
 
+# ----------------------------------------------------------------- long ctx
+
+def bench_long_context():
+    """Long-context leg (beyond-reference: the reference's fmha caps at
+    seqlen 512 buckets and apex has no context parallelism): a full
+    O2+FusedAdam train step at 8k tokens through the O(S) flash kernel,
+    plus a compile-time capability proof at 32k — XLA's memory analysis
+    of the O(S²) composition vs the Pallas kernel for one attention
+    fwd+bwd, without risking the chip on an OOM."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.models import GPTConfig, GPTModel, gpt_loss_fn
+    from apex_tpu.optim import fused_adam
+    from apex_tpu.ops.attention import fused_attention, attention_reference
+
+    b = int(os.environ.get("BENCH_BATCH", "1"))
+    s = int(os.environ.get("BENCH_SEQ", "8192"))
+    cfg = GPTConfig(
+        vocab_size=32768, hidden_size=1024, num_layers=12,
+        num_heads=16, max_seq_len=s, dtype=jnp.bfloat16, remat=True,
+        scan_layers=False)
+    model = GPTModel(cfg)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(0), (b, s + 1), 0, cfg.vocab_size, jnp.int32)
+    inputs, labels = ids[:, :-1], ids[:, 1:]
+    params = model.init(jax.random.PRNGKey(0), inputs[:1])
+    state = amp.initialize(
+        model.apply, params, fused_adam(1e-4, moment_dtype=jnp.bfloat16),
+        opt_level="O2", half_dtype=jnp.bfloat16)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, inputs, labels):
+        def loss_fn(p):
+            cp = state.policy.cast_to_compute(p)
+            logits = state.apply_fn(cp, inputs)
+            loss = gpt_loss_fn(logits.astype(jnp.float32), labels)
+            return state.scale_loss(loss), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
+        new_state, finite = state.apply_gradients(grads=grads)
+        return new_state, loss, finite
+
+    out = _measure(state, step, (inputs, labels), b,
+                   {"batch": b, "seq": s})
+    out["tokens_per_sec"] = round(out["value"] * s, 1)
+
+    # 32k capability proof: compile one attention fwd+bwd both ways and
+    # compare XLA's per-device temp memory (no execution)
+    s32, h, d = 32768, 8, 64
+    q = jax.ShapeDtypeStruct((1, s32, h, d), jnp.bfloat16)
+
+    def attn_loss(impl):
+        def f(qq, kk, vv):
+            o = (fused_attention(qq, kk, vv, causal=True,
+                                 implementation="pallas")
+                 if impl == "pallas" else
+                 attention_reference(qq, kk, vv, causal=True))
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+        return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+    mems = {}
+    for impl in ("pallas", "xla"):
+        try:
+            stats = attn_loss(impl).lower(q, q, q).compile(
+            ).memory_analysis()
+            mems[impl] = int(stats.temp_size_in_bytes)
+        except Exception as e:                     # composition may not
+            mems[impl] = f"uncompilable: {type(e).__name__}"   # even fit
+    out["attn_32k_temp_bytes"] = mems
+    out["metric"] = "gpt_long_context_8k_O2_samples_per_sec_per_chip"
+    _emit(out)
+
+
 # ----------------------------------------------------------------- ViT-Huge
 
 def bench_vit_huge_lamb():
@@ -418,6 +493,7 @@ LEGS = {
     "gpt2_1p3b": bench_gpt2_1p3b,
     "gpt2_tp8_compile": bench_gpt2_tp8_compile,
     "vit_huge_lamb": bench_vit_huge_lamb,
+    "long_context": bench_long_context,
 }
 
 # legs that must run on the virtual CPU mesh, not the real chip

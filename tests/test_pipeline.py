@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.core import mesh as mesh_lib
 from apex_tpu.core.mesh import PIPE_AXIS
 from apex_tpu.transformer import microbatches as mb_lib
 from apex_tpu.transformer.pipeline_parallel import (
@@ -296,6 +297,38 @@ class TestInterleavedSchedule:
             np.testing.assert_allclose(np.asarray(g), np.asarray(wg),
                                        rtol=2e-4, atol=1e-5)
 
+    @pytest.mark.parametrize("v,m", [(2, 8), (3, 4)])
+    def test_matches_sequential_pp4(self, rng, v, m):
+        """pp=4: the feed ring's multi-hop shift phase (up to pp-1
+        consecutive hops per window) — pp=2 degenerates to one hop and
+        cannot catch window-phase off-by-ones."""
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_with_interleaving)
+        mesh = mesh_lib.initialize_mesh(pipeline_model_parallel_size=4,
+                                        data_parallel_size=2)
+        try:
+            pp = 4
+            stacked = _stacked_params_vpp(rng, v, pp)
+            batch = jnp.asarray(rng.normal(size=(m * MB, SEQ, HID)),
+                                jnp.float32)
+
+            def loss_fn(y, idx):
+                return jnp.mean(y ** 2)
+
+            loss, grads = forward_backward_pipelining_with_interleaving(
+                _stage_fn, loss_fn, stacked, batch, mesh=mesh,
+                num_microbatches=m)
+            want_loss, want_grads = _sequential_reference_vpp(
+                stacked, batch, m)
+            np.testing.assert_allclose(float(loss), float(want_loss),
+                                       rtol=1e-5)
+            for g, wg in zip(jax.tree.leaves(grads),
+                             jax.tree.leaves(want_grads)):
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(wg), rtol=2e-4, atol=1e-5)
+        finally:
+            mesh_lib.destroy_mesh()
+
     def test_requires_divisible_microbatches(self, rng, mesh8):
         from apex_tpu.transformer.pipeline_parallel import (
             forward_backward_pipelining_with_interleaving)
@@ -320,7 +353,7 @@ class TestInterleavedSchedule:
         def loss_fn(y, idx):
             return jnp.mean(y ** 2)
 
-        def temp_bytes(m):
+        def mem_stats(m):
             f = jax.jit(
                 lambda p, b: forward_backward_pipelining_with_interleaving(
                     _stage_fn, loss_fn, p, b, mesh=mesh8,
@@ -332,10 +365,16 @@ class TestInterleavedSchedule:
                 jax.ShapeDtypeStruct((m * MB, SEQ, HID), jnp.float32))
             stats = lowered.compile().memory_analysis()
             assert stats is not None
-            return stats.temp_size_in_bytes
+            return stats.temp_size_in_bytes, stats.argument_size_in_bytes
 
-        t4, t32 = temp_bytes(4), temp_bytes(32)
+        (t4, a4), (t32, a32) = mem_stats(4), mem_stats(32)
         assert t32 <= 1.5 * t4 + 4096, (t4, t32)
+        # inputs cyclically sharded + feed-ring streamed: per-rank
+        # argument growth is (M2-M1)/pp microbatches, not (M2-M1)
+        mb_bytes = MB * SEQ * HID * 4
+        pp = mesh8.shape[PIPE_AXIS]
+        assert a32 - a4 <= 1.5 * (32 - 4) * mb_bytes / pp + 4096, (
+            a4, a32, mb_bytes)
 
     def test_dispatch(self):
         from apex_tpu.transformer.pipeline_parallel import (
