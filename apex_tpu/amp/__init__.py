@@ -20,6 +20,10 @@ from apex_tpu.amp.lists import (
     FP32_FUNCS,
     PROMOTE_FUNCS,
     classify_op,
+    register_half_function,
+    register_float_function,
+    register_promote_function,
+    deregister_function,
 )
 from apex_tpu.core.precision import PrecisionPolicy
 
@@ -34,5 +38,9 @@ __all__ = [
     "FP32_FUNCS",
     "PROMOTE_FUNCS",
     "classify_op",
+    "register_half_function",
+    "register_float_function",
+    "register_promote_function",
+    "deregister_function",
     "o1",
 ]

@@ -32,6 +32,8 @@ from typing import Literal
 
 __all__ = [
     "HALF_FUNCS", "FP32_FUNCS", "PROMOTE_FUNCS",
+    "register_half_function", "register_float_function",
+    "register_promote_function", "deregister_function",
     "FUNCTIONAL_HALF", "FUNCTIONAL_FP32", "FUNCTIONAL_PROMOTE",
     "TORCH_HALF", "TORCH_FP32", "TORCH_PROMOTE",
     "TENSOR_HALF", "TENSOR_FP32", "TENSOR_PROMOTE",
@@ -217,14 +219,67 @@ TORCH_ALIASES = {
 }
 
 
+# ---------------------------------------------------------------------------
+# user registration (reference: apex.amp.register_half_function /
+# register_float_function / register_promote_function — the public
+# extension points for classifying custom ops under O1)
+# ---------------------------------------------------------------------------
+
+_REGISTERED: dict = {}
+
+
+def _register(kind: str, module_or_name, function_name=None) -> None:
+    """Accepts the reference's ``(module, "fn_name")`` form or a bare
+    op-name string; registrations take precedence over the built-in
+    tables (matching the reference, whose registrations patch last)."""
+    name = (function_name if function_name is not None
+            else module_or_name)
+    if not isinstance(name, str):
+        raise TypeError(
+            f"register_*_function takes (module, 'fn_name') or a "
+            f"name string, got {type(name).__name__}")
+    _REGISTERED[TORCH_ALIASES.get(name, name)] = kind
+
+
+def register_half_function(module_or_name, function_name=None) -> None:
+    """Classify an op as MXU/half under O1 (reference:
+    ``apex.amp.register_half_function(module, 'fn')``)."""
+    _register("half", module_or_name, function_name)
+
+
+def register_float_function(module_or_name, function_name=None) -> None:
+    """Classify an op as always-fp32 under O1 (reference:
+    ``apex.amp.register_float_function``)."""
+    _register("fp32", module_or_name, function_name)
+
+
+def register_promote_function(module_or_name, function_name=None) -> None:
+    """Classify an op as widest-input-promoting under O1 (reference:
+    ``apex.amp.register_promote_function``)."""
+    _register("promote", module_or_name, function_name)
+
+
+def deregister_function(module_or_name, function_name=None) -> None:
+    """Remove a user registration (tests/teardown; the built-in tables
+    are untouched)."""
+    name = (function_name if function_name is not None
+            else module_or_name)
+    _REGISTERED.pop(TORCH_ALIASES.get(name, name), None)
+
+
 def classify_op(name: str) -> Literal["half", "fp32", "promote", "passthrough"]:
     """Classify an op name for O1 casting, defaulting to passthrough
     (reference: ops absent from every list keep their input dtype).
 
     Accepts canonical JAX-centric names, reference torch spellings (via
-    ``TORCH_ALIASES``), and ``Tensor.<method>`` spellings.
+    ``TORCH_ALIASES``), and ``Tensor.<method>`` spellings.  User
+    registrations (:func:`register_half_function` et al.) take
+    precedence over the built-in tables.
     """
     name = TORCH_ALIASES.get(name, name)
+    reg = _REGISTERED.get(name)
+    if reg is not None:
+        return reg
     if name in HALF_FUNCS:
         return "half"
     if name in FP32_FUNCS:

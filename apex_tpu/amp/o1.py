@@ -65,7 +65,10 @@ def cast_op(name: str, fn: Callable, *args: Any,
 # Module-class-name → op-classification, the flax-module-level analogue
 # of the reference's torch_overrides/functional_overrides lists.
 _HALF_MODULES = ("dense", "conv", "linear", "einsum", "attention",
-                 "densegeneral", "mlp")
+                 "densegeneral", "mlp",
+                 # recurrent cells run whole-cell half, the reference's
+                 # rnn_compat semantics (fp32 masters, half compute)
+                 "lstm", "gru", "rnncell")
 _FP32_MODULES = ("layernorm", "batchnorm", "groupnorm", "rmsnorm",
                  "norm", "softmax", "crossentropy", "loss", "embed")
 

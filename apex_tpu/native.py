@@ -8,7 +8,7 @@ works without the native build, just slower on large host buffers.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Sequence
 
 import numpy as np
 

@@ -14,7 +14,7 @@ one fused jit region over the pytree (amp_C parity, SURVEY.md §2.2).
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp

@@ -79,10 +79,15 @@ def _public_names(mod):
 
 
 def _signature(obj):
+    import re
+
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return ""
+    # strip live object addresses (sentinel defaults etc.) so the
+    # generated docs are deterministic across machines/runs
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
 
 
 def _render_symbol(name, obj, errors, qual):

@@ -29,6 +29,10 @@ class Net(nn.Module):
 
 
 def main():
+    # multi-host: pick up MASTER_ADDR/RANK/WORLD_SIZE (the reference
+    # launcher's env contract) if set; single-host no-op
+    from apex_tpu.parallel import init_distributed
+    init_distributed()
     mesh = initialize_mesh(data_parallel_size=-1)
     ndev = len(jax.devices())
     print(f"mesh: {ndev} device(s) on the 'data' axis")

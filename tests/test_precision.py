@@ -381,3 +381,68 @@ class TestCrossOptLevelTraces:
             np.testing.assert_allclose(
                 traces[lvl][-1], traces["O0"][-1],
                 rtol=0.15, err_msg=lvl)
+
+
+class TestRegistrationAPI:
+    """apex.amp.register_half_function / register_float_function /
+    register_promote_function — the reference's public extension points
+    for classifying custom ops under O1."""
+
+    def test_register_and_precedence(self):
+        from apex_tpu import amp
+        from apex_tpu.amp import lists, o1
+
+        try:
+            assert lists.classify_op("my_custom_matmul") == "passthrough"
+            amp.register_half_function("my_custom_matmul")
+            assert lists.classify_op("my_custom_matmul") == "half"
+            y = o1.cast_op("my_custom_matmul", jnp.matmul,
+                           jnp.ones((2, 2)), jnp.ones((2, 2)))
+            assert y.dtype == jnp.bfloat16
+            # registration overrides the built-in table (reference:
+            # registrations patch last)
+            amp.register_float_function("matmul")
+            assert lists.classify_op("matmul") == "fp32"
+            # module-form signature parity
+            import types
+            fake = types.ModuleType("fake")
+            amp.register_promote_function(fake, "blend")
+            assert lists.classify_op("blend") == "promote"
+        finally:
+            amp.deregister_function("my_custom_matmul")
+            amp.deregister_function("matmul")
+            amp.deregister_function("blend")
+        assert lists.classify_op("matmul") == "half"
+        assert lists.classify_op("my_custom_matmul") == "passthrough"
+
+    def test_bad_name_type_raises(self):
+        from apex_tpu import amp
+
+        with pytest.raises(TypeError):
+            amp.register_half_function(42)
+
+
+class TestO1RecurrentCells:
+    """Reference rnn_compat: RNN cells run half under O1.  flax cells
+    build on nn.Dense internally, so the interceptor catches their
+    matmuls per-op — verify the compute dtype end-to-end."""
+
+    def test_lstm_cell_runs_half_under_o1(self, rng):
+        import flax.linen as nn
+        from apex_tpu.amp import o1
+
+        cell = nn.OptimizedLSTMCell(features=16)
+        x = jnp.asarray(rng.normal(size=(2, 8)), jnp.float32)
+        carry = cell.initialize_carry(jax.random.PRNGKey(0), x.shape)
+        v = cell.init(jax.random.PRNGKey(1), carry, x)
+        with o1.o1_intercept(jnp.bfloat16):
+            (_, h), y = cell.apply(v, carry, x)
+        assert y.dtype == jnp.bfloat16
+        # and it still trains: grads flow through the cast cell
+        def loss(p):
+            with o1.o1_intercept(jnp.bfloat16):
+                (_, h2), _ = cell.apply(p, carry, x)
+            return jnp.sum(h2.astype(jnp.float32) ** 2)
+        g = jax.grad(loss)(v)
+        assert all(bool(jnp.all(jnp.isfinite(l)))
+                   for l in jax.tree.leaves(g))
