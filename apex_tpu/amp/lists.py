@@ -226,19 +226,40 @@ TORCH_ALIASES = {
 # ---------------------------------------------------------------------------
 
 _REGISTERED: dict = {}
+_REGISTERED_SOURCE: dict = {}
 
 
 def _register(kind: str, module_or_name, function_name=None) -> None:
     """Accepts the reference's ``(module, "fn_name")`` form or a bare
     op-name string; registrations take precedence over the built-in
-    tables (matching the reference, whose registrations patch last)."""
+    tables (matching the reference, whose registrations patch last).
+
+    Unlike the reference (which patches each module object
+    independently), classification here is keyed by bare op name — so
+    two *different* modules registering the same function name with
+    conflicting kinds is ambiguous and raises instead of silently
+    letting the last registration win."""
     name = (function_name if function_name is not None
             else module_or_name)
     if not isinstance(name, str):
         raise TypeError(
             f"register_*_function takes (module, 'fn_name') or a "
             f"name string, got {type(name).__name__}")
-    _REGISTERED[TORCH_ALIASES.get(name, name)] = kind
+    source = (getattr(module_or_name, "__name__", repr(module_or_name))
+              if function_name is not None else None)
+    key = TORCH_ALIASES.get(name, name)
+    prev_kind = _REGISTERED.get(key)
+    prev_src = _REGISTERED_SOURCE.get(key)
+    if (prev_kind is not None and prev_kind != kind
+            and prev_src != source):
+        raise ValueError(
+            f"conflicting O1 registration for '{key}': "
+            f"{prev_kind!r} (from {prev_src}) vs {kind!r} (from "
+            f"{source}) — classification is keyed by op name; "
+            f"deregister_function('{key}') first if the override is "
+            f"intended")
+    _REGISTERED[key] = kind
+    _REGISTERED_SOURCE[key] = source
 
 
 def register_half_function(module_or_name, function_name=None) -> None:
@@ -264,7 +285,9 @@ def deregister_function(module_or_name, function_name=None) -> None:
     are untouched)."""
     name = (function_name if function_name is not None
             else module_or_name)
-    _REGISTERED.pop(TORCH_ALIASES.get(name, name), None)
+    key = TORCH_ALIASES.get(name, name)
+    _REGISTERED.pop(key, None)
+    _REGISTERED_SOURCE.pop(key, None)
 
 
 def classify_op(name: str) -> Literal["half", "fp32", "promote", "passthrough"]:

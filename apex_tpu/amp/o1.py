@@ -67,8 +67,10 @@ def cast_op(name: str, fn: Callable, *args: Any,
 _HALF_MODULES = ("dense", "conv", "linear", "einsum", "attention",
                  "densegeneral", "mlp",
                  # recurrent cells run whole-cell half, the reference's
-                 # rnn_compat semantics (fp32 masters, half compute)
-                 "lstm", "gru", "rnncell")
+                 # rnn_compat semantics (fp32 masters, half compute) —
+                 # covers LSTMCell/OptimizedLSTMCell/ConvLSTMCell,
+                 # GRUCell/MGUCell, SimpleCell
+                 "lstm", "gru", "mgucell", "simplecell", "rnncell")
 _FP32_MODULES = ("layernorm", "batchnorm", "groupnorm", "rmsnorm",
                  "norm", "softmax", "crossentropy", "loss", "embed")
 

@@ -19,6 +19,7 @@ from apex_tpu.models.resnet import ResNetConfig, ResNet, resnet50, resnet18
 from apex_tpu.models.vit import ViTConfig, ViTModel
 
 __all__ = [
+    "load_torch_gpt2",
     "TransformerConfig",
     "ParallelTransformer",
     "ParallelTransformerLayer",
@@ -33,3 +34,4 @@ __all__ = [
     "ResNetConfig", "ResNet", "resnet50", "resnet18",
     "ViTConfig", "ViTModel",
 ]
+from apex_tpu.models.torch_import import load_torch_gpt2  # noqa: E402

@@ -1,0 +1,177 @@
+"""Import torch (HuggingFace-format) GPT-2 weights into apex_tpu models.
+
+Migration machinery: a user of the reference trains on torch — switching
+frameworks means bringing checkpoints along.  :func:`load_torch_gpt2`
+maps a ``GPT2LMHeadModel``/``GPT2Model`` state dict onto
+:class:`apex_tpu.models.GPTModel` parameters (both architectures are
+pre-LN with tied embeddings, so the mapping is exact — verified by the
+cross-framework logits test in ``tests/test_models.py``).
+
+Notes on conventions:
+
+- HF GPT-2 linear layers are ``Conv1D`` modules whose weights are
+  stored **(in, out)** — the same layout as flax kernels, so no
+  transposes anywhere.
+- ``c_attn`` packs q|k|v along the output dim in the same order as
+  ``qkv_proj``; the head reshape convention also matches.
+- Works for both the unrolled (``layer_{i}``) and scanned (stacked
+  ``layers/layer`` with a leading layer axis) parameter forms.
+- ``nn.Partitioned``-boxed leaves keep their sharding metadata
+  (values are replaced in-box).
+
+BERT is deliberately NOT importable: HF BERT is post-LN while this
+library's transformer (Megatron recipe) is pre-LN — a key-by-key weight
+copy would silently compute a different function.  Convert through a
+re-training or distillation step instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["load_torch_gpt2"]
+
+
+def _to_np(x) -> np.ndarray:
+    if hasattr(x, "detach"):                       # torch tensor
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def _set_leaf(leaf, value: np.ndarray):
+    """Replace a param leaf's value, preserving Partitioned boxing."""
+    import flax.core.meta as meta
+
+    if isinstance(leaf, meta.AxisMetadata):
+        inner = leaf.unbox()
+        if inner.shape != value.shape:
+            raise ValueError(
+                f"shape mismatch: model {inner.shape} vs torch "
+                f"{value.shape}")
+        return leaf.replace_boxed(jnp.asarray(value, inner.dtype))
+    if leaf.shape != value.shape:
+        raise ValueError(
+            f"shape mismatch: model {leaf.shape} vs torch {value.shape}")
+    return jnp.asarray(value, leaf.dtype)
+
+
+def _layer_mapping(i: int) -> dict:
+    """HF ``h.{i}.*`` → our per-layer subtree paths."""
+    h = f"h.{i}."
+    return {
+        h + "ln_1.weight": ("input_norm", "scale"),
+        h + "ln_1.bias": ("input_norm", "bias"),
+        h + "attn.c_attn.weight": ("attention", "qkv_proj", "kernel"),
+        h + "attn.c_attn.bias": ("attention", "qkv_proj", "bias"),
+        h + "attn.c_proj.weight": ("attention", "out_proj", "kernel"),
+        h + "attn.c_proj.bias": ("attention", "out_proj", "bias"),
+        h + "ln_2.weight": ("post_attention_norm", "scale"),
+        h + "ln_2.bias": ("post_attention_norm", "bias"),
+        h + "mlp.c_fc.weight": ("mlp", "dense_h_to_4h", "kernel"),
+        h + "mlp.c_fc.bias": ("mlp", "dense_h_to_4h", "bias"),
+        h + "mlp.c_proj.weight": ("mlp", "dense_4h_to_h", "kernel"),
+        h + "mlp.c_proj.bias": ("mlp", "dense_4h_to_h", "bias"),
+    }
+
+
+def load_torch_gpt2(params: Any, state_dict: Mapping[str, Any]) -> Any:
+    """Map an HF GPT-2 state dict onto a GPTModel ``params`` pytree.
+
+    ``params``: the (possibly ``init``-fresh) variables dict or its
+    ``["params"]`` subtree; returned with every mapped leaf replaced.
+    ``state_dict``: ``model.state_dict()`` of a ``GPT2LMHeadModel`` /
+    ``GPT2Model`` (torch tensors or numpy arrays; the
+    ``transformer.``-prefixed and unprefixed key forms both work).
+    """
+    sd = {}
+    for k, val in state_dict.items():
+        if k.startswith("transformer."):
+            k = k[len("transformer."):]
+        sd[k] = val
+
+    wrapped = "params" in params
+    tree = dict(params["params"] if wrapped else params)
+
+    def fetch(key):
+        if key not in sd:
+            raise KeyError(
+                f"torch state dict is missing '{key}' (have e.g. "
+                f"{sorted(sd)[:4]}...)")
+        return _to_np(sd[key])
+
+    def put(path, key):
+        node = tree
+        for p in path[:-1]:
+            node = node[p]
+        node[path[-1]] = _set_leaf(node[path[-1]], fetch(key))
+
+    # deep-copy the nested dicts we mutate
+    import copy
+
+    tree = copy.deepcopy(tree)
+
+    put(("embedding", "embedding"), "wte.weight")
+    if "position_embedding" in tree:
+        wpe = fetch("wpe.weight")
+        target = tree["position_embedding"]
+        tlen = (target.unbox().shape[0]
+                if hasattr(target, "unbox") else target.shape[0])
+        if wpe.shape[0] < tlen:
+            raise ValueError(
+                f"torch wpe covers {wpe.shape[0]} positions < model "
+                f"max_seq_len {tlen}")
+        tree["position_embedding"] = _set_leaf(target, wpe[:tlen])
+    put(("final_norm", "scale"), "ln_f.weight")
+    put(("final_norm", "bias"), "ln_f.bias")
+    if "lm_head" in tree:
+        # untied head: HF lm_head is nn.Linear with (vocab, hid)
+        # weights — transpose to the flax (in, out) kernel
+        head = fetch("lm_head.weight").T
+        tree["lm_head"]["kernel"] = _set_leaf(
+            tree["lm_head"]["kernel"], head)
+
+    trans = tree["transformer"]
+    def check_layer_count(n_layers):
+        if f"h.{n_layers}.ln_1.weight" in sd:
+            extra = sum(1 for k in sd if k.endswith(".ln_1.weight"))
+            raise ValueError(
+                f"torch checkpoint has {extra} layers but the model "
+                f"has {n_layers} — refusing to silently truncate")
+
+    if any(k.startswith("layer_") for k in trans):
+        n_layers = sum(k.startswith("layer_") for k in trans)
+        check_layer_count(n_layers)
+        for i in range(n_layers):
+            for key, path in _layer_mapping(i).items():
+                put(("transformer", f"layer_{i}") + path, key)
+    else:
+        # scanned form: stack each leaf across layers on a new axis 0
+        sub = trans["layers"]["layer"]
+
+        def stacked(path):
+            node = sub
+            for p in path:
+                node = node[p]
+            n_layers = (node.unbox().shape[0]
+                        if hasattr(node, "unbox") else node.shape[0])
+            return node, n_layers
+
+        # iterate the mapping of layer 0 to learn the paths, then stack
+        checked = False
+        for key0, path in _layer_mapping(0).items():
+            node, n_layers = stacked(path)
+            if not checked:
+                check_layer_count(n_layers)
+                checked = True
+            suffix = key0[len("h.0."):]
+            vals = np.stack([
+                fetch(f"h.{i}.{suffix}") for i in range(n_layers)])
+            target = sub
+            for p in path[:-1]:
+                target = target[p]
+            target[path[-1]] = _set_leaf(target[path[-1]], vals)
+
+    return {"params": tree} if wrapped else tree

@@ -61,7 +61,8 @@ PAGES = {
                 "apex_tpu.contrib.sparsity"],
     "models": ["apex_tpu.models.bert", "apex_tpu.models.gpt",
                "apex_tpu.models.vit", "apex_tpu.models.resnet",
-               "apex_tpu.models.transformer"],
+               "apex_tpu.models.transformer",
+               "apex_tpu.models.torch_import"],
     "utils": ["apex_tpu.utils.checkpoint", "apex_tpu.utils.profiler",
               "apex_tpu.utils.debug", "apex_tpu.utils.metrics",
               "apex_tpu.utils.tree"],

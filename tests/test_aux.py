@@ -154,3 +154,27 @@ class TestMetrics:
         jax.effects_barrier()
         assert [s for s, _ in rows] == [0, 1, 2]
         assert rows[2][1]["loss"] == 4.0
+
+
+class TestProfiler:
+    """jax.profiler wrappers (SURVEY.md §5 tracing row — exceeds the
+    reference, which has no first-class profiling)."""
+
+    def test_trace_writes_artifacts(self, tmp_path):
+        import jax
+
+        d = str(tmp_path / "trace")
+        with utils.profiler.trace(d):
+            with utils.profiler.annotate("probe_matmul"):
+                x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+                jax.block_until_ready(x)
+        import pathlib
+        files = list(pathlib.Path(d).rglob("*"))
+        assert any(f.is_file() for f in files), files
+
+    def test_memory_profile_written(self, tmp_path):
+        p = str(tmp_path / "mem.prof")
+        _ = jnp.ones((128, 128)) + 1.0
+        utils.profiler.save_device_memory_profile(p)
+        import os
+        assert os.path.exists(p) and os.path.getsize(p) > 0

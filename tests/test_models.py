@@ -253,3 +253,89 @@ class TestBertMlmPositions:
                     rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(np.asarray(pooled_g),
                                    np.asarray(pooled_full), rtol=1e-6)
+
+
+class TestTorchImport:
+    """Cross-framework weight import: a locally-constructed HF GPT-2
+    (random init, no download) must produce the same logits as
+    GPTModel after load_torch_gpt2 — exact architectural parity
+    (pre-LN, tied embeddings, Conv1D (in,out) weights)."""
+
+    @pytest.mark.parametrize("scan", [False, True])
+    def test_gpt2_logits_match_torch(self, scan):
+        import dataclasses
+
+        import torch
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.models.torch_import import load_torch_gpt2
+
+        torch.manual_seed(0)
+        hf_cfg = GPT2Config(
+            vocab_size=128, n_positions=32, n_embd=64, n_layer=2,
+            n_head=2, activation_function="gelu_new",
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+        tm = GPT2LMHeadModel(hf_cfg).eval()
+
+        cfg = GPTConfig(
+            vocab_size=128, hidden_size=64, num_layers=2, num_heads=2,
+            max_seq_len=32, position_embedding="learned",
+            scan_layers=scan)
+        model = GPTModel(cfg)
+        ids_np = np.random.default_rng(0).integers(
+            0, 128, size=(2, 16)).astype(np.int64)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.asarray(ids_np, jnp.int32))
+        params = load_torch_gpt2(params, tm.state_dict())
+
+        with torch.no_grad():
+            want = tm(torch.from_numpy(ids_np)).logits.numpy()
+        got = np.asarray(model.apply(
+            params, jnp.asarray(ids_np, jnp.int32), deterministic=True),
+            np.float32)
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+    def test_missing_key_raises(self):
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.models.torch_import import load_torch_gpt2
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=2, max_seq_len=16,
+                        position_embedding="learned")
+        model = GPTModel(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+        with pytest.raises(KeyError, match="wte"):
+            load_torch_gpt2(params, {})
+
+    def test_layer_count_mismatch_raises(self):
+        import torch
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.models.torch_import import load_torch_gpt2
+
+        tm = GPT2LMHeadModel(GPT2Config(
+            vocab_size=64, n_positions=16, n_embd=32, n_layer=4,
+            n_head=2))
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=16,
+                        position_embedding="learned")
+        model = GPTModel(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+        with pytest.raises(ValueError, match="refusing"):
+            load_torch_gpt2(params, tm.state_dict())
+
+    def test_registration_conflict_raises(self):
+        import types
+        from apex_tpu import amp
+
+        a, b = types.ModuleType("mod_a"), types.ModuleType("mod_b")
+        try:
+            amp.register_half_function(a, "fwd_shared")
+            with pytest.raises(ValueError, match="conflicting"):
+                amp.register_float_function(b, "fwd_shared")
+        finally:
+            amp.deregister_function("fwd_shared")
