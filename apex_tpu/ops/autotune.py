@@ -21,6 +21,14 @@ or programmatically::
 The cache persists to ``APEX_TPU_AUTOTUNE_CACHE`` (default
 ``~/.cache/apex_tpu/autotune.json``) keyed by backend+device kind, so
 one sweep serves all subsequent processes on the same hardware.
+
+**Measure end-to-end before trusting a sweep.**  Isolated-kernel
+winners can lose inside a full training step (measured on v5e:
+micro-bench-optimal LN blocks of 32–64 rows cost ~1% of BERT-Large
+step time vs the VMEM-budget heuristic, because XLA overlaps the
+row-wise kernels differently in context) — the same lesson as
+attention-tile sweeps (BASELINE.md round-1 notes).  Tune, run your
+real step, and delete the cache entry if it regresses.
 Timing uses a host-transfer sync (``device_get`` of a dependent
 scalar): on tunneled backends ``block_until_ready`` returns at
 dispatch and would measure nothing (see ``bench.py::_sync``).
