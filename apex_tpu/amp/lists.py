@@ -250,8 +250,9 @@ def _register(kind: str, module_or_name, function_name=None) -> None:
     key = TORCH_ALIASES.get(name, name)
     prev_kind = _REGISTERED.get(key)
     prev_src = _REGISTERED_SOURCE.get(key)
-    if (prev_kind is not None and prev_kind != kind
-            and prev_src != source):
+    if prev_kind is not None and prev_kind != kind:
+        # any kind change is ambiguous — including re-registration from
+        # the same module or two bare-name (source=None) registrations
         raise ValueError(
             f"conflicting O1 registration for '{key}': "
             f"{prev_kind!r} (from {prev_src}) vs {kind!r} (from "

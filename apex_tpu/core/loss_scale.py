@@ -178,9 +178,13 @@ class NoOpLossScale(StaticLossScale):
     """Identity loss scale for O0/O3 and bf16 policies."""
 
     def __init__(self, scale: float = 1.0, **fields):
-        # accept (and forward) dataclass fields so dataclasses.replace
-        # works here too; the scale is pinned to 1 regardless
+        # accept dataclass fields so dataclasses.replace works here
+        # too, but pin every scale-valued field to 1 regardless —
+        # otherwise replace(noop, init_scale=X) would report
+        # scale_value == X while scale()/unscale() stay identity
         del scale
+        for pinned in ("init_scale", "max_scale", "min_scale"):
+            fields.pop(pinned, None)
         super().__init__(scale=1.0, **fields)
 
     def scale(self, state: LossScaleState, loss: Any) -> Any:

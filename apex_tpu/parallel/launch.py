@@ -71,11 +71,34 @@ def init_distributed(
 
     import jax
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kwargs,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except Exception as e:
+        missing = [n for n, v in
+                   (("MASTER_ADDR", coordinator_address),
+                    ("WORLD_SIZE", num_processes),
+                    ("RANK", process_id)) if v is None]
+        if missing:
+            # a partial launch env (some of coordinator/world/rank
+            # unresolved, and jax's cluster autodetection couldn't fill
+            # the gaps either) otherwise surfaces as an opaque
+            # JAX-internal error; name the reference-style env vars
+            # that would complete it — keeping the underlying error in
+            # the message, since with autodetection in play the true
+            # cause may be e.g. a connection failure instead
+            raise ValueError(
+                f"jax.distributed.initialize failed "
+                f"({type(e).__name__}: {e}) with "
+                f"{' and '.join(missing)} unresolved — if the "
+                f"underlying error is about the missing field(s), set "
+                f"the named env var(s) or pass coordinator_address/"
+                f"num_processes/process_id explicitly; otherwise see "
+                f"the chained error") from e
+        raise
     _INITIALIZED = True
     return True

@@ -136,3 +136,15 @@ class TestStaticAndNoOp:
         assert ls.scale(st, loss) is loss
         grads = {"w": jnp.ones(3)}
         assert ls.unscale(st, grads) is grads
+
+    def test_noop_replace_keeps_scale_pinned(self):
+        import dataclasses
+        # round-2 advisor: replace(noop, init_scale=X) must not produce
+        # a NoOp whose scale_value reports X while scale() is identity
+        ls = NoOpLossScale()
+        ls2 = dataclasses.replace(ls, init_scale=64.0)
+        assert ls2.scale_value == 1.0
+        assert ls2.init_scale == 1.0
+        assert ls2.max_scale == 1.0 and ls2.min_scale == 1.0
+        loss = jnp.asarray(2.0)
+        assert ls2.scale(ls2.init(), loss) is loss

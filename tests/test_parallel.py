@@ -429,3 +429,20 @@ class TestLaunch:
                            capture_output=True, text=True, timeout=300)
         assert r.returncode == 0, r.stderr[-1500:]
         assert "LAUNCH_OK" in r.stdout
+
+    def test_partial_env_raises_descriptive(self, monkeypatch):
+        # round-2 advisor: MASTER_ADDR without WORLD_SIZE/RANK must
+        # surface as a descriptive error naming the missing vars, not a
+        # JAX-internal failure from initialize(num_processes=None);
+        # match the dynamic per-case prefix, not the static tail
+        from apex_tpu.parallel import init_distributed
+
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.delenv("WORLD_SIZE", raising=False)
+        monkeypatch.delenv("RANK", raising=False)
+        with pytest.raises(ValueError,
+                           match="WORLD_SIZE and RANK unresolved"):
+            init_distributed()
+        monkeypatch.setenv("WORLD_SIZE", "2")
+        with pytest.raises(ValueError, match=r"with RANK unresolved"):
+            init_distributed()

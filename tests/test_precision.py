@@ -421,6 +421,25 @@ class TestRegistrationAPI:
         with pytest.raises(TypeError):
             amp.register_half_function(42)
 
+    def test_conflicting_kind_raises_even_same_source(self):
+        # round-2 advisor: two bare-name registrations (source=None)
+        # with conflicting kinds must raise, not let the last one win
+        from apex_tpu import amp
+
+        try:
+            amp.register_half_function("my_conflicted_op")
+            with pytest.raises(ValueError, match="conflicting"):
+                amp.register_float_function("my_conflicted_op")
+            # same kind re-registration stays allowed (idempotent)
+            amp.register_half_function("my_conflicted_op")
+            # deregister-then-reregister is the sanctioned override path
+            amp.deregister_function("my_conflicted_op")
+            amp.register_float_function("my_conflicted_op")
+            from apex_tpu.amp import lists
+            assert lists.classify_op("my_conflicted_op") == "fp32"
+        finally:
+            amp.deregister_function("my_conflicted_op")
+
 
 class TestO1RecurrentCells:
     """Reference rnn_compat: RNN cells run half under O1.  flax cells
