@@ -5,6 +5,7 @@ p2p_communication + utils).  See :mod:`.schedules` for the TPU design
 (scan + ppermute inside shard_map; backward by transposition).
 """
 
+from apex_tpu.transformer.pipeline_parallel.build import build_model
 from apex_tpu.transformer.pipeline_parallel.schedules import (
     spmd_pipeline,
     spmd_pipeline_interleaved,
@@ -16,6 +17,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
 from apex_tpu.transformer.pipeline_parallel import p2p
 
 __all__ = [
+    "build_model",
     "spmd_pipeline",
     "spmd_pipeline_interleaved",
     "forward_backward_no_pipelining",

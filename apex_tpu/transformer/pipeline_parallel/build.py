@@ -1,0 +1,118 @@
+"""``build_model`` — stack a homogeneous layer into pipeline stages.
+
+Reference: ``apex/transformer/pipeline_parallel/utils.py::build_model``
+(SURVEY.md §2.6 schedules row) — the reference builds a list of model
+chunks, one per (virtual) pipeline stage, so users never hand-slice
+their model.  The TPU analogue stacks *parameters* instead of modules:
+the schedules (:mod:`.schedules`) expect a ``(pp, ...)`` (or
+``(V, pp, ...)`` interleaved) leading stack on every parameter leaf plus
+a matching :class:`~jax.sharding.PartitionSpec` tree, which every caller
+previously assembled by hand with ``jax.vmap`` + ``jax.tree.map``.
+
+:func:`build_model` does that assembly once: init every layer, reshape
+the stacked leaves into the schedule's stage layout (interleaved chunk
+``c`` on rank ``r`` implements global stage ``c*pp + r``, matching
+``spmd_pipeline_1f1b_interleaved``), derive the spec tree from the
+layer's own flax partitioning metadata (so TP-sharded weights stay
+TP-sharded inside each stage), and return a ``stage_fn`` that scans the
+per-stage layers — compile-friendly, no Python loop per layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.core.mesh import PIPE_AXIS
+
+__all__ = ["build_model"]
+
+
+def build_model(
+    layer_module,
+    num_layers: int,
+    pipeline_model_parallel_size: int,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    *,
+    rng,
+    sample_input,
+    axis: str = PIPE_AXIS,
+) -> Tuple[Callable, Any, Any]:
+    """Build ``(stage_fn, stacked_params, params_spec)`` for the
+    pipeline schedules.
+
+    ``layer_module`` is one flax layer (e.g.
+    :class:`~apex_tpu.models.ParallelTransformerLayer`) applied
+    ``num_layers`` times; ``sample_input`` is one microbatch activation
+    ``(mb, seq, hidden)`` used for shape inference.  ``num_layers`` must
+    divide evenly into ``pp * V`` stages; each stage applies
+    ``num_layers // (pp * V)`` layers via ``lax.scan``.
+
+    Returns:
+      - ``stage_fn(stage_params, x) -> y`` — one pipeline stage, for
+        :func:`.schedules.forward_backward_pipelining_without_interleaving`
+        (or the interleaved driver when ``V > 1``),
+      - ``stacked_params`` — unboxed pytree whose leaves lead with
+        ``(pp, layers_per_stage, ...)`` (``(V, pp, layers_per_stage,
+        ...)`` interleaved), independently initialized per layer from
+        ``rng``,
+      - ``params_spec`` — matching ``PartitionSpec`` tree: ``axis`` over
+        the stage dim, the layer's own partitioning (tensor axes) on the
+        parameter dims — use it to ``device_put`` the stacked params so
+        TP weights land sharded.  Do NOT pass it to the schedule
+        drivers: their ``params_spec`` argument is a ``shard_map``
+        in_spec restricted to the manual pipe axis, and their defaults
+        (``P(axis)`` / ``P(None, axis)``) already match this layout —
+        the tensor-axis sharding rides along via GSPMD.
+    """
+    import flax.linen as nn
+
+    pp = pipeline_model_parallel_size
+    v = virtual_pipeline_model_parallel_size or 1
+    n_stages = pp * v
+    if num_layers % n_stages != 0:
+        raise ValueError(
+            f"num_layers={num_layers} must be divisible by "
+            f"pp*V={pp}*{v}={n_stages}")
+    per_stage = num_layers // n_stages
+
+    def layer_init(key):
+        return layer_module.init(key, sample_input)
+
+    keys = jax.random.split(rng, num_layers)
+    stacked = jax.vmap(layer_init)(keys)          # (num_layers, ...)
+    # one layer's spec from its own flax partitioning metadata, before
+    # unboxing (vmap leaves the Partitioned names un-lifted, so the
+    # layer-level eval_shape is the reliable source)
+    layer_spec = nn.get_partition_spec(
+        jax.eval_shape(layer_init, jax.random.PRNGKey(0)))
+    stacked = nn.meta.unbox(stacked)
+
+    if v > 1:
+        # (V, pp, per_stage, ...): chunk c on rank r = stage c*pp + r,
+        # covering layers [(c*pp + r) * per_stage, ...) — row-major
+        # reshape gives exactly that ordering
+        stacked = jax.tree.map(
+            lambda a: a.reshape(v, pp, per_stage, *a.shape[1:]), stacked)
+        prefix = (None, axis, None)
+    else:
+        stacked = jax.tree.map(
+            lambda a: a.reshape(pp, per_stage, *a.shape[1:]), stacked)
+        prefix = (axis, None)
+
+    params_spec = jax.tree.map(
+        lambda s: P(*prefix, *s), layer_spec,
+        is_leaf=lambda x: isinstance(x, P))
+
+    def stage_fn(stage_params, x):
+        def body(h, layer_params):
+            return layer_module.apply(layer_params, h), None
+
+        y, _ = lax.scan(body, x, stage_params)
+        return y
+
+    return stage_fn, stacked, params_spec

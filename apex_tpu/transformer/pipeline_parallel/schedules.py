@@ -64,6 +64,90 @@ __all__ = [
 ]
 
 
+# collective (and collective-inducing) primitives that make lax.cond
+# dead-tick skipping unsafe — see _unit
+_COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pbroadcast", "psum_scatter", "reduce_scatter",
+    "sharding_constraint", "collective_permute", "pgather",
+})
+
+
+def _contains_collectives(jaxpr) -> bool:
+    """Recursively scan a jaxpr (and sub-jaxprs) for collectives."""
+    def subs(v):
+        if isinstance(v, jax.extend.core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jax.extend.core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for item in v:
+                yield from subs(item)
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _COLLECTIVE_PRIMS:
+            return True
+        for val in eqn.params.values():
+            for sub in subs(val):
+                if _contains_collectives(sub):
+                    return True
+    return False
+
+
+def _traces_collectives(fn, *args) -> bool:
+    """True if tracing ``fn(*args)`` records any collective primitive
+    (explicit ``lax.p*`` or a sharding constraint that GSPMD may lower
+    to one).  Unable-to-trace counts as True (the safe answer)."""
+    try:
+        jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
+    except Exception:
+        return True
+    return _contains_collectives(jaxpr)
+
+
+def _unit(skip, pred, live_fn, dead_fn, operands):
+    """One schedule unit: ``lax.cond``-skipped or computed-and-masked.
+
+    Dead warmup/cooldown units are cheapest skipped with ``lax.cond``
+    — but 1F1B's predicates vary over the pipe rank, and a collective
+    inside a branch only some ranks enter deadlocks the program: the
+    non-entering ranks never send (TPU) / never join the rendezvous
+    (CPU).  GSPMD freely places collectives inside the branch when the
+    stage body is tensor/sequence-parallel (observed: the qkv-slice
+    reshard of ``ParallelAttention`` under tp=2), so cond-skipping is
+    only sound for collective-free stage bodies — the driver
+    auto-detects via :func:`_traces_collectives` (``skip_dead_ticks``
+    overrides).  The masked form computes every unit and selects
+    results — dead units burn stage-compute during warmup/cooldown
+    ticks (bounded by the bubble fraction) but every collective runs
+    unconditionally on every rank.
+    """
+    if skip:
+        return lax.cond(pred, live_fn, dead_fn, operands)
+    live = live_fn(operands)
+    dead = dead_fn(operands)
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), live, dead)
+
+
+def _after(first, x):
+    """Return ``x`` ordered after ``first`` (``optimization_barrier``).
+
+    One 1F1B tick contains several mutually data-independent collective
+    groups: the GSPMD collectives inside the forward / loss / backward
+    units (e.g. tensor-parallel all-reduces in the stage body) and the
+    three ring ``ppermute``\\ s.  XLA's CPU thunk executor dispatches
+    independent ops concurrently in a timing-dependent order, so two
+    devices can enter two such collectives in opposite orders and
+    deadlock the in-process rendezvous (observed with attention-sized
+    stage bodies).  Chaining the groups with barriers imposes the same
+    total order on every device.  On TPU each core executes thunks in
+    program order anyway, so the barrier costs nothing; the serialized
+    rings move one microbatch each — noise next to stage compute.
+    """
+    x, _ = lax.optimization_barrier((x, first))
+    return x
+
+
 # --------------------------------------------------------------------- #
 # core: collective SPMD pipeline (inside shard_map)
 # --------------------------------------------------------------------- #
@@ -149,6 +233,9 @@ def spmd_pipeline_1f1b(
     *,
     axis: str = PIPE_AXIS,
     microbatches_distributed: bool = False,
+    skip_dead_ticks: Optional[bool] = None,
+    loss_params: Any = None,
+    return_input_cotangents: bool = False,
 ):
     """One-forward-one-backward pipeline, computing ``(loss, grads)``
     directly — the schedule IS the backward pass, not its autodiff
@@ -177,8 +264,11 @@ def spmd_pipeline_1f1b(
       cotangent back; the input-cotangent rides the reverse
       ``ppermute`` ring to rank ``r-1``, the parameter-cotangent
       accumulates into the scan carry.
-    - dead warmup/cooldown units are *skipped* (``lax.cond``), not
-      computed-and-masked.
+    - dead warmup/cooldown units are *skipped* (``lax.cond``) when the
+      stage/loss bodies are collective-free, else computed-and-masked —
+      a collective inside a branch only some pipe ranks enter would
+      deadlock (see :func:`_unit`).  ``skip_dead_ticks`` overrides the
+      auto-detection (``None``).
 
     Memory: carry = fwd/bwd ring activations + ``2*pp`` stash slots +
     grad accumulator — flat in M (asserted by
@@ -206,6 +296,30 @@ def spmd_pipeline_1f1b(
     ``q*pp + j``, which is when microbatch ``q*pp + j`` enters the
     pipeline.  One extra single-microbatch ``ppermute`` per tick,
     overlapped with the stage compute like the main rings.
+
+    **Embedding/head closure** (Megatron's ``build_model``
+    stage-embedding special-casing, SURVEY.md §2.6): a full train step
+    also needs gradients for parameters living *outside* the pipelined
+    stage stack.
+
+    - ``loss_params``: when given, the loss signature becomes
+      ``loss_fn(loss_params, y, microbatch_index)`` (e.g. the LM head
+      weights + labels-side state) and a third return element carries
+      ``d loss / d loss_params``, accumulated over the rank-``pp-1``
+      loss units (zeros elsewhere; the driver psums over ``axis``).
+    - ``return_input_cotangents=True``: additionally return the stack
+      of rank-0 backward input-cotangents ``(M, mb, ...)`` — exactly
+      ``d loss / d h`` for each pipeline-input microbatch ``h`` — so
+      the caller can close the embedding backward
+      (``d_embed = zeros.at[ids].add(cts)``).  This buffer is O(M) by
+      necessity (the embedding backward needs every microbatch's
+      cotangent); the O(pp) live-activation property of the schedule
+      itself is unchanged.
+
+    With either option the return is ``(loss_local, grads_local,
+    extras)`` where ``extras`` holds ``"loss_params_grads"`` and/or
+    ``"input_cotangents"`` (both rank-local; see the driver for the
+    psum/replication).
     """
     pp = lax.axis_size(axis)
     rank = lax.axis_index(axis)
@@ -229,6 +343,18 @@ def spmd_pipeline_1f1b(
 
     mb_shape = microbatches[0]
 
+    if skip_dead_ticks is None:
+        # cond-skipping dead units is only sound for collective-free
+        # stage/loss bodies (see _unit); detect and fall back to the
+        # computed-and-masked form otherwise
+        if loss_params is None:
+            loss_probe = lambda y: loss_fn(y, jnp.int32(0))
+        else:
+            loss_probe = lambda y: loss_fn(loss_params, y, jnp.int32(0))
+        skip_dead_ticks = not (
+            _traces_collectives(stage_fn, params_local, mb_shape)
+            or _traces_collectives(loss_probe, mb_shape))
+
     def varying(x):
         """Mark ``x`` device-varying over ``axis`` (no-op if already)."""
         try:
@@ -236,8 +362,17 @@ def spmd_pipeline_1f1b(
         except ValueError:
             return x
 
+    # mark loss_params varying BEFORE the vjp: pulling a cotangent for
+    # a pipe-INVARIANT input makes the transpose insert a psum over
+    # `axis` inside the (rank-divergent) loss cond — a deadlock (see
+    # _unit); varying is metadata-only and the driver psums the grads
+    # explicitly afterwards
+    if loss_params is not None:
+        loss_params = jax.tree.map(varying, loss_params)
+
     def tick(carry, t):
-        fwd_x, bwd_ct, pending_ct, feed, stash, loss_acc, grad_acc = carry
+        (fwd_x, bwd_ct, pending_ct, feed, stash, loss_acc, grad_acc,
+         lp_grad_acc) = carry
 
         # ---- forward unit: microbatch mf = t - rank ----
         mf = t - rank
@@ -251,9 +386,9 @@ def spmd_pipeline_1f1b(
                 microbatches, jnp.clip(mf, 0, num_micro - 1), axis=0,
                 keepdims=False)
         x = jnp.where(rank == 0, mb, fwd_x)
-        y = lax.cond(valid_f,
-                     lambda a: varying(stage_fn(params_local, a)),
-                     lambda a: varying(jnp.zeros_like(a)), x)
+        y = _unit(skip_dead_ticks, valid_f,
+                  lambda a: varying(stage_fn(params_local, a)),
+                  lambda a: varying(jnp.zeros_like(a)), x)
         # stash the stage INPUT (slot mf mod 2pp; live range < 2pp so
         # no collision); dead units must not overwrite a live slot
         slot = jnp.clip(mf, 0, num_micro - 1) % n_slots
@@ -263,20 +398,35 @@ def spmd_pipeline_1f1b(
 
         # ---- loss + output-cotangent on the last rank ----
         def loss_and_ct(y):
-            lval, pull = jax.vjp(lambda yy: loss_fn(yy, mf), y)
+            if loss_params is None:
+                lval, pull = jax.vjp(lambda yy: loss_fn(yy, mf), y)
+            else:
+                lval, pull = jax.vjp(
+                    lambda lp, yy: loss_fn(lp, yy, mf), loss_params, y)
             # compute 1/M in f32 first: a bf16 loss_fn would otherwise
             # round the seed (and the f32 zero in the false branch
             # requires an f32 loss either way)
             seed = varying((jnp.float32(1) / num_micro).astype(lval.dtype))
-            (ct,) = pull(seed)
-            return varying(lval.astype(jnp.float32)), varying(ct)
+            if loss_params is None:
+                (ct,) = pull(seed)
+                glp = ()
+            else:
+                glp, ct = pull(seed)
+            return (varying(lval.astype(jnp.float32)), varying(ct),
+                    jax.tree.map(varying, glp))
 
         is_last = rank == pp - 1
-        lval, new_pending = lax.cond(
-            valid_f & is_last, loss_and_ct,
+        lval, new_pending, glp = _unit(
+            skip_dead_ticks, valid_f & is_last, loss_and_ct,
             lambda y: (varying(jnp.zeros((), jnp.float32)),
-                       varying(jnp.zeros_like(y))), y)
+                       varying(jnp.zeros_like(y)),
+                       jax.tree.map(
+                           lambda a: varying(jnp.zeros_like(a)),
+                           () if loss_params is None else loss_params)),
+            y)
         loss_acc = loss_acc + lval
+        if loss_params is not None:
+            lp_grad_acc = jax.tree.map(jnp.add, lp_grad_acc, glp)
 
         # ---- backward unit: microbatch mb_b = t - (2pp-1) + rank ----
         mb_b = t - (2 * pp - 1) + rank
@@ -287,7 +437,8 @@ def spmd_pipeline_1f1b(
         # incoming cotangent: reverse ring from rank r+1; the last rank
         # feeds itself the loss cotangent it computed LAST tick (for
         # exactly the microbatch whose backward is due this tick)
-        ct_in = jnp.where(is_last, pending_ct, bwd_ct)
+        # (ordered after the forward+loss units — see _after)
+        ct_in = _after((y, lval), jnp.where(is_last, pending_ct, bwd_ct))
 
         def run_bwd(operands):
             x_s, ct = operands
@@ -295,17 +446,17 @@ def spmd_pipeline_1f1b(
             gp, gx = pull(ct)
             return jax.tree.map(varying, (gp, gx))
 
-        gp, gx = lax.cond(
-            valid_b, run_bwd,
+        gp, gx = _unit(
+            skip_dead_ticks, valid_b, run_bwd,
             lambda operands: jax.tree.map(varying, (
                 jax.tree.map(jnp.zeros_like, params_local),
                 jnp.zeros_like(operands[0]))),
             (x_saved, ct_in))
         grad_acc = jax.tree.map(jnp.add, grad_acc, gp)
 
-        # ---- rings ----
-        fwd_x = send_forward_recv_forward(y, axis=axis)
-        bwd_ct = send_backward_recv_backward(gx, axis=axis)
+        # ---- rings (barrier-chained into one device-uniform order) ----
+        fwd_x = send_forward_recv_forward(_after(gx, y), axis=axis)
+        bwd_ct = send_backward_recv_backward(_after(fwd_x, gx), axis=axis)
         if microbatches_distributed:
             # re-establish the feed invariant for tick t+1: inject the
             # next local microbatch every pp ticks, else shift the feed
@@ -315,10 +466,16 @@ def spmd_pipeline_1f1b(
                 microbatches, jnp.clip(nxt_q, 0, local_n - 1), axis=0,
                 keepdims=False)
             shifted = lax.ppermute(
-                feed, axis, [(i, (i - 1) % pp) for i in range(pp)])
+                _after(bwd_ct, feed), axis,
+                [(i, (i - 1) % pp) for i in range(pp)])
             feed = jnp.where((t + 1) % pp == 0, local_next, shifted)
+        emit = None
+        if return_input_cotangents:
+            # rank 0's input-cotangent = dL/d(pipeline input) for
+            # microbatch mb_b; zeros on other ranks / dead units
+            emit = jnp.where(rank == 0, gx, jnp.zeros_like(gx))
         return (fwd_x, bwd_ct, new_pending, feed, stash, loss_acc,
-                grad_acc), None
+                grad_acc, lp_grad_acc), emit
 
     feed0 = (varying(microbatches[0]) if microbatches_distributed
              else varying(jnp.zeros((), mb_shape.dtype)))
@@ -333,10 +490,23 @@ def spmd_pipeline_1f1b(
         # grad acc: zeros_like(params) is already device-varying (the
         # params came in split over `axis`), so no pcast here
         jax.tree.map(jnp.zeros_like, params_local),          # grad acc
+        # loss-params grad acc (replicated zeros -> mark varying: only
+        # the last rank accumulates)
+        jax.tree.map(lambda a: varying(jnp.zeros_like(a)),
+                     () if loss_params is None else loss_params),
     )
-    carry, _ = lax.scan(tick, init, jnp.arange(n_ticks))
-    loss_acc, grad_acc = carry[-2], carry[-1]
-    return loss_acc, grad_acc
+    carry, ys = lax.scan(tick, init, jnp.arange(n_ticks))
+    loss_acc, grad_acc, lp_grad_acc = carry[-3], carry[-2], carry[-1]
+    if loss_params is None and not return_input_cotangents:
+        return loss_acc, grad_acc
+    extras = {}
+    if loss_params is not None:
+        extras["loss_params_grads"] = lp_grad_acc
+    if return_input_cotangents:
+        # rank 0's backward for microbatch mb runs at tick mb + 2pp-1
+        extras["input_cotangents"] = ys[2 * pp - 1:
+                                        2 * pp - 1 + num_micro]
+    return loss_acc, grad_acc, extras
 
 
 # --------------------------------------------------------------------- #
@@ -350,6 +520,7 @@ def spmd_pipeline_1f1b_interleaved(
     *,
     axis: str = PIPE_AXIS,
     microbatches_distributed: bool = False,
+    skip_dead_ticks: Optional[bool] = None,
 ):
     """Interleaved (virtual-pipeline) one-forward-one-backward schedule
     computing ``(loss, grads)`` with O(pp·V) live activations.
@@ -428,6 +599,15 @@ def spmd_pipeline_1f1b_interleaved(
 
     mb_shape = microbatches[0]
 
+    if skip_dead_ticks is None:
+        # see _unit: cond-skipping requires collective-free bodies
+        chunk0 = jax.tree.map(
+            lambda a: a[0] if a.ndim else a, params_local)
+        skip_dead_ticks = not (
+            _traces_collectives(stage_fn, chunk0, mb_shape)
+            or _traces_collectives(
+                lambda y: loss_fn(y, jnp.int32(0)), mb_shape))
+
     def varying(x):
         try:
             return lax.pcast(x, (axis,), to="varying")
@@ -464,8 +644,8 @@ def spmd_pipeline_1f1b_interleaved(
         # rank 0 lap 0 injects fresh microbatches; every other (rank,
         # lap) consumes the fwd-ring hand-off (wrap link = lap hand-off)
         x = jnp.where((rank == 0) & (c_f == 0), mb, fwd_x)
-        y = lax.cond(
-            valid_f,
+        y = _unit(
+            skip_dead_ticks, valid_f,
             lambda a: varying(stage_fn(chunk_params(c_f), a)),
             lambda a: varying(jnp.zeros_like(a)), x)
         slot_f = iv % n_slots
@@ -483,8 +663,8 @@ def spmd_pipeline_1f1b_interleaved(
 
         is_last = rank == pp - 1
         fire_loss = valid_f & is_last & (c_f == v - 1)
-        lval, maybe_pending = lax.cond(
-            fire_loss, loss_and_ct,
+        lval, maybe_pending = _unit(
+            skip_dead_ticks, fire_loss, loss_and_ct,
             lambda y: (varying(jnp.zeros((), jnp.float32)),
                        varying(jnp.zeros_like(y))), y)
         # only overwrite the pending slot when a loss actually fired —
@@ -507,7 +687,9 @@ def spmd_pipeline_1f1b_interleaved(
         # pending loss cotangent (computed last tick); everything else
         # reads the reverse ring (whose wrap link 0 -> pp-1 is the
         # backward lap hand-off)
-        ct_in = jnp.where(is_last & (c_b == v - 1), pending_ct, bwd_ct)
+        # (ordered after the forward+loss units — see _after)
+        ct_in = _after((y, lval), jnp.where(
+            is_last & (c_b == v - 1), pending_ct, bwd_ct))
 
         def run_bwd(operands):
             x_s, ct = operands
@@ -516,8 +698,8 @@ def spmd_pipeline_1f1b_interleaved(
             gp, gx = pull(ct)
             return jax.tree.map(varying, (gp, gx))
 
-        gp, gx = lax.cond(
-            valid_b, run_bwd,
+        gp, gx = _unit(
+            skip_dead_ticks, valid_b, run_bwd,
             lambda operands: jax.tree.map(varying, (
                 jax.tree.map(jnp.zeros_like, chunk_params(0)),
                 jnp.zeros_like(operands[0]))),
@@ -530,9 +712,9 @@ def spmd_pipeline_1f1b_interleaved(
                 + g, c_b, axis=0) if acc.ndim else acc + g,
             grad_acc, gp)
 
-        # ---- rings ----
-        fwd_x = send_forward_recv_forward(y, axis=axis)
-        bwd_ct = send_backward_recv_backward(gx, axis=axis)
+        # ---- rings (barrier-chained into one device-uniform order) ----
+        fwd_x = send_forward_recv_forward(_after(gx, y), axis=axis)
+        bwd_ct = send_backward_recv_backward(_after(fwd_x, gx), axis=axis)
         if microbatches_distributed:
             # re-establish the feed invariant for tick t+1: inject the
             # next local microbatch at each V·pp-tick window start,
@@ -545,7 +727,8 @@ def spmd_pipeline_1f1b_interleaved(
                 jnp.clip(tn // (v * pp), 0, local_n - 1),
                 axis=0, keepdims=False)
             shifted = lax.ppermute(
-                feed, axis, [(i, (i - 1) % pp) for i in range(pp)])
+                _after(bwd_ct, feed), axis,
+                [(i, (i - 1) % pp) for i in range(pp)])
             feed = jnp.where(
                 win == 0, local_next,
                 jnp.where(win < pp, shifted, feed))
@@ -770,6 +953,9 @@ def forward_backward_pipelining_without_interleaving(
     axis: str = PIPE_AXIS,
     remat: bool = True,
     params_spec: Optional[Any] = None,
+    skip_dead_ticks: Optional[bool] = None,
+    loss_params: Any = None,
+    return_input_cotangents: bool = False,
 ):
     """Pipelined forward+backward (reference: 1F1B,
     ``fwd_bwd_pipelining_without_interleaving.py``).
@@ -779,6 +965,14 @@ def forward_backward_pipelining_without_interleaving(
     ``loss_fn(y, microbatch_index) -> scalar`` scores last-stage output.
     ``batch``: ``(M * mb, seq, hidden)``.  Returns ``(loss, grads)``
     with ``grads`` matching ``stage_params``.
+
+    ``loss_params`` / ``return_input_cotangents`` close the
+    embedding/head gradients over the pipelined region (see
+    :func:`spmd_pipeline_1f1b`): with either set, returns ``(loss,
+    grads, aux)`` where ``aux["loss_params_grads"]`` matches
+    ``loss_params`` (already summed over ranks) and
+    ``aux["input_cotangents"]`` is ``(M, mb, ...)`` — ``dL/dh`` per
+    pipeline-input microbatch, replicated over ``axis``.
 
     This drives :func:`spmd_pipeline_1f1b` — the explicit
     one-forward-one-backward tick table with O(pp) live activations —
@@ -799,18 +993,32 @@ def forward_backward_pipelining_without_interleaving(
     mbs, mb_spec, distributed = _distribute_microbatches(
         mbs, m, mesh, axis)
 
+    has_aux = loss_params is not None or return_input_cotangents
+    aux_specs = {}
+    if loss_params is not None:
+        aux_specs["loss_params_grads"] = jax.tree.map(
+            lambda _: P(), loss_params)
+    if return_input_cotangents:
+        aux_specs["input_cotangents"] = P()
+
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(pspec, mb_spec), out_specs=(P(), pspec),
+        in_specs=(pspec, mb_spec),
+        out_specs=((P(), pspec, aux_specs) if has_aux
+                   else (P(), pspec)),
         # only `pipe` goes manual: data/tensor axes inside the stage
         # remain GSPMD-managed, so TP layers compose with the pipeline
         axis_names={axis})
     def run(params_local, mbs_local):
         if distributed:
             mbs_local = mbs_local[0]     # strip the split pp dim
-        loss_local, grads_local = spmd_pipeline_1f1b(
+        out = spmd_pipeline_1f1b(
             stage_fn, loss_fn, params_local, mbs_local, axis=axis,
-            microbatches_distributed=distributed)
+            microbatches_distributed=distributed,
+            skip_dead_ticks=skip_dead_ticks,
+            loss_params=loss_params,
+            return_input_cotangents=return_input_cotangents)
+        loss_local, grads_local = out[0], out[1]
         # loss_local is the per-microbatch sum on rank pp-1, 0 elsewhere
         loss = lax.psum(loss_local, axis) / m
         # restore the stripped stacked-stage axis for the out_spec
@@ -820,7 +1028,21 @@ def forward_backward_pipelining_without_interleaving(
         grads = jax.tree.map(
             lambda g, a: g[None] if a.ndim else lax.psum(g, axis),
             grads_local, params_local)
-        return loss, grads
+        if not has_aux:
+            return loss, grads
+        extras = out[2]
+        aux = {}
+        if loss_params is not None:
+            # fired on rank pp-1 only; psum replicates the sum
+            aux["loss_params_grads"] = jax.tree.map(
+                lambda g: lax.psum(g, axis),
+                extras["loss_params_grads"])
+        if return_input_cotangents:
+            cts = extras["input_cotangents"]
+            aux["input_cotangents"] = lax.psum(
+                jnp.where(lax.axis_index(axis) == 0, cts,
+                          jnp.zeros_like(cts)), axis)
+        return loss, grads, aux
 
     return run(stage_params, mbs)
 
@@ -836,6 +1058,7 @@ def forward_backward_pipelining_with_interleaving(
     axis: str = PIPE_AXIS,
     remat: bool = True,
     params_spec: Optional[Any] = None,
+    skip_dead_ticks: Optional[bool] = None,
 ):
     """Interleaved pipelined forward+backward (reference:
     ``fwd_bwd_pipelining_with_interleaving.py``).
@@ -872,7 +1095,8 @@ def forward_backward_pipelining_with_interleaving(
             mbs_local = mbs_local[0]     # strip the split pp dim
         loss_local, grads_local = spmd_pipeline_1f1b_interleaved(
             stage_fn, loss_fn, params_local, mbs_local, axis=axis,
-            microbatches_distributed=distributed)
+            microbatches_distributed=distributed,
+            skip_dead_ticks=skip_dead_ticks)
         loss = lax.psum(loss_local, axis) / m
         # restore the stripped split-pp axis for the out_spec: local
         # grads are (V, ...); the spec expects (V, 1, ...).  0-d
