@@ -20,6 +20,17 @@ Semantics parity:
   them).
 - To freeze a subset of params, wrap with ``optax.masked`` (the JAX
   idiom for the reference's per-param-group machinery).
+
+Beyond-reference: ``moment_format="fp8_block_scaled"`` stores both Adam
+moments as float8_e4m3 with one fp32 scale per 256-element block
+(compute stays fp32) — the algorithmic-traffic-reduction lever
+BASELINE.md's roofline analysis identifies as the only remaining one
+for the HBM-bound BERT step.  Raw e4m3 cannot hold second moments
+(min normal ≈ 2⁻⁶ flushes the typical 1e-12..1e-4 range to zero), so
+the block scale carries the magnitude and e4m3 carries ~2-decimal-digit
+mantissa within the block — the FP8-optimizer-state recipe of 8-bit
+Adam (block-wise quantization).  Storage: 1 byte + 4/256 per moment
+element vs 4 (or 2 with ``moment_dtype=bf16``).
 """
 
 from __future__ import annotations
@@ -31,6 +42,32 @@ import jax.numpy as jnp
 import optax
 
 __all__ = ["fused_adam", "FusedAdamState"]
+
+_FP8 = jnp.float8_e4m3fn
+_FP8_MAX = 448.0          # e4m3 finite max
+_FP8_BLOCK = 256
+
+
+def _fp8_zeros(p):
+    n = max(1, p.size)
+    npad = -(-n // _FP8_BLOCK) * _FP8_BLOCK
+    return {"q": jnp.zeros((npad,), _FP8),
+            "scale": jnp.zeros((npad // _FP8_BLOCK,), jnp.float32)}
+
+
+def _fp8_dequant(st, n):
+    q = st["q"].reshape(-1, _FP8_BLOCK).astype(jnp.float32)
+    return (q * st["scale"][:, None]).reshape(-1)[:n]
+
+
+def _fp8_quant(x_flat):
+    n = x_flat.shape[0]
+    npad = -(-max(1, n) // _FP8_BLOCK) * _FP8_BLOCK
+    xb = jnp.pad(x_flat, (0, npad - n)).reshape(-1, _FP8_BLOCK)
+    absmax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
+    scale = jnp.maximum(absmax / _FP8_MAX, 1e-30)
+    return {"q": (xb / scale).astype(_FP8).reshape(-1),
+            "scale": scale[:, 0]}
 
 
 class FusedAdamState(NamedTuple):
@@ -55,16 +92,33 @@ def fused_adam(
     adam_w_mode: bool = True,
     bias_correction: bool = True,
     moment_dtype: Optional[Any] = None,
+    moment_format: str = "dense",
 ) -> optax.GradientTransformation:
     """Build the FusedAdam gradient transformation.
 
     ``moment_dtype`` optionally stores moments in a reduced dtype
     (reference stores fp32 moments; default None = match params).
+    ``moment_format="fp8_block_scaled"`` stores both moments as
+    float8_e4m3 + per-256-block fp32 scales with fp32 compute
+    (beyond-reference; see module docstring) — ``moment_dtype`` is
+    ignored in that case.  Single-chip / replicated-state prototype:
+    the blocks run over the *flattened* leaf, so with GSPMD-sharded
+    params the quantized state crosses shard boundaries and XLA
+    gathers the full moment per leaf — keep ``"dense"`` (optionally
+    with ``moment_dtype``) for sharded optimizer state.
     """
+    if moment_format not in ("dense", "fp8_block_scaled"):
+        raise ValueError(
+            f"moment_format={moment_format!r} not in "
+            f"('dense', 'fp8_block_scaled')")
+    fp8 = moment_format == "fp8_block_scaled"
 
     def init(params):
-        zeros = lambda p: jnp.zeros_like(
-            p, dtype=moment_dtype or jnp.asarray(p).dtype)
+        if fp8:
+            zeros = _fp8_zeros
+        else:
+            zeros = lambda p: jnp.zeros_like(
+                p, dtype=moment_dtype or jnp.asarray(p).dtype)
         return FusedAdamState(
             count=jnp.zeros((), jnp.int32),
             exp_avg=jax.tree.map(zeros, params),
@@ -84,17 +138,29 @@ def fused_adam(
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
         def leaf(g, p, m, v):
-            gf = g.astype(m.dtype)
-            pf = p.astype(m.dtype)
+            if fp8:
+                n = p.size
+                m_f = _fp8_dequant(m, n)
+                v_f = _fp8_dequant(v, n)
+                gf = g.astype(jnp.float32).reshape(-1)
+                pf = p.astype(jnp.float32).reshape(-1)
+            else:
+                m_f, v_f = m, v
+                gf = g.astype(m.dtype)
+                pf = p.astype(m.dtype)
             if not adam_w_mode and weight_decay != 0.0:
                 gf = gf + weight_decay * pf
-            m_new = b1 * m + (1.0 - b1) * gf
-            v_new = b2 * v + (1.0 - b2) * jnp.square(gf)
+            m_new = b1 * m_f + (1.0 - b1) * gf
+            v_new = b2 * v_f + (1.0 - b2) * jnp.square(gf)
             denom = jnp.sqrt(v_new / bc2) + eps
             step = m_new / (bc1 * denom)
             if adam_w_mode and weight_decay != 0.0:
                 step = step + weight_decay * pf
-            return (-lr * step).astype(p.dtype), m_new, v_new
+            upd = -lr * step
+            if fp8:
+                return (upd.reshape(p.shape).astype(p.dtype),
+                        _fp8_quant(m_new), _fp8_quant(v_new))
+            return upd.astype(p.dtype), m_new, v_new
 
         g_leaves, treedef = jax.tree.flatten(grads)
         p_leaves = treedef.flatten_up_to(params)

@@ -50,6 +50,7 @@ PAGES = {
                     "apex_tpu.transformer.microbatches",
                     "apex_tpu.transformer.parallel_state",
                     "apex_tpu.transformer.pipeline_parallel.schedules",
+                    "apex_tpu.transformer.pipeline_parallel.build",
                     "apex_tpu.transformer.pipeline_parallel.p2p"],
     "contrib": ["apex_tpu.contrib", "apex_tpu.contrib.fmha",
                 "apex_tpu.contrib.focal_loss",
