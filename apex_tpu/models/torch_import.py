@@ -12,8 +12,10 @@ Notes on conventions:
 - HF GPT-2 linear layers are ``Conv1D`` modules whose weights are
   stored **(in, out)** — the same layout as flax kernels, so no
   transposes anywhere.
-- ``c_attn`` packs q|k|v along the output dim in the same order as
-  ``qkv_proj``; the head reshape convention also matches.
+- ``c_attn`` packs q|k|v flat along the output dim; ``qkv_proj`` uses
+  the Megatron per-head-grouped layout ([q_i k_i v_i] blocks, which
+  keeps the TP split shard-local), so the importer permutes the c_attn
+  output columns — ``num_heads`` is required for that.
 - Works for both the unrolled (``layer_{i}``) and scanned (stacked
   ``layers/layer`` with a leading layer axis) parameter forms.
 - ``nn.Partitioned``-boxed leaves keep their sharding metadata
@@ -58,6 +60,20 @@ def _set_leaf(leaf, value: np.ndarray):
     return jnp.asarray(value, leaf.dtype)
 
 
+def _qkv_flat_to_grouped(w: np.ndarray, num_heads: int) -> np.ndarray:
+    """Permute a flat ``[q|k|v]`` output axis (HF c_attn) into the
+    per-head-grouped ``[q_i k_i v_i]`` layout of ``qkv_proj``."""
+    out = w.shape[-1]
+    if out % (3 * num_heads):
+        raise ValueError(
+            f"c_attn output dim {out} not divisible by 3*num_heads="
+            f"{3 * num_heads}")
+    d = out // (3 * num_heads)
+    idx = np.arange(out).reshape(3, num_heads, d)
+    perm = idx.transpose(1, 0, 2).reshape(-1)       # head-major
+    return np.ascontiguousarray(w[..., perm])
+
+
 def _layer_mapping(i: int) -> dict:
     """HF ``h.{i}.*`` → our per-layer subtree paths."""
     h = f"h.{i}."
@@ -77,7 +93,8 @@ def _layer_mapping(i: int) -> dict:
     }
 
 
-def load_torch_gpt2(params: Any, state_dict: Mapping[str, Any]) -> Any:
+def load_torch_gpt2(params: Any, state_dict: Mapping[str, Any], *,
+                    num_heads: int, qkv_grouped: bool = True) -> Any:
     """Map an HF GPT-2 state dict onto a GPTModel ``params`` pytree.
 
     ``params``: the (possibly ``init``-fresh) variables dict or its
@@ -85,6 +102,11 @@ def load_torch_gpt2(params: Any, state_dict: Mapping[str, Any]) -> Any:
     ``state_dict``: ``model.state_dict()`` of a ``GPT2LMHeadModel`` /
     ``GPT2Model`` (torch tensors or numpy arrays; the
     ``transformer.``-prefixed and unprefixed key forms both work).
+    ``num_heads``: the model's attention head count — needed to permute
+    c_attn's flat [q|k|v] columns into qkv_proj's per-head-grouped
+    layout.  ``qkv_grouped`` must match the model's
+    ``TransformerConfig.qkv_grouped`` (pass ``False`` for models built
+    with the flat layout, e.g. single-chip long-context configs).
     """
     sd = {}
     for k, val in state_dict.items():
@@ -100,7 +122,11 @@ def load_torch_gpt2(params: Any, state_dict: Mapping[str, Any]) -> Any:
             raise KeyError(
                 f"torch state dict is missing '{key}' (have e.g. "
                 f"{sorted(sd)[:4]}...)")
-        return _to_np(sd[key])
+        val = _to_np(sd[key])
+        if qkv_grouped and (key.endswith("attn.c_attn.weight")
+                            or key.endswith("attn.c_attn.bias")):
+            val = _qkv_flat_to_grouped(val, num_heads)
+        return val
 
     def put(path, key):
         node = tree

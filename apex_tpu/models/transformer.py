@@ -75,10 +75,18 @@ class TransformerConfig:
     # with remat=True and unrolled layers: every k-th layer skips remat
     # entirely (keeps activations, no backward recompute) — 0 disables
     remat_skip_every: int = 0
-    # flash-attention kernel tile sizes (isolated-op sweeps can mislead:
-    # in the full rematted model 512/512 measures fastest at s=512)
-    attention_block_q: int = 512
-    attention_block_k: int = 512
+    # flash-attention kernel tile sizes; None = the kernel's seq-aware
+    # default (512 at short seq — isolated-op sweeps can mislead: in
+    # the full rematted model 512/512 measures fastest at s=512 — and
+    # 1024 from 16k up, 21% faster measured at 32k)
+    attention_block_q: Optional[int] = None
+    attention_block_k: Optional[int] = None
+    # Megatron per-head-grouped qkv layout: keeps the q/k/v split
+    # shard-local under TP (without it GSPMD inserts cross-shard
+    # permutes in every layer).  Costs extra strided-slice temps that
+    # XLA pads 2x at d=64 — at very long sequence on a single chip
+    # (no TP benefit) turn it off to save HBM.
+    qkv_grouped: bool = True
     scan_layers: bool = True
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -156,9 +164,24 @@ class ParallelAttention(nn.Module):
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name="qkv_proj")(x)
-        q = qkv[..., : h * d].reshape(b, s, h, d)
-        k = qkv[..., h * d: (h + hk) * d].reshape(b, s, hk, d)
-        v = qkv[..., (h + hk) * d:].reshape(b, s, hk, d)
+        if cfg.qkv_grouped:
+            # Megatron qkv layout: features grouped per kv-head —
+            # [q_g·rep … q_g·rep+rep-1, k_g, v_g] per group g — so the
+            # q/k/v split is a reshape along UNSHARDED dims and stays
+            # shard-local under TP (the flat [q|k|v] layout's slice
+            # boundaries cross tensor shards, making GSPMD insert
+            # cross-shard collective-permutes in every layer).  Head
+            # order is unchanged (q heads stay g-major = the standard
+            # GQA grouping; for MHA it's the identity).
+            rep = h // hk
+            grouped = qkv.reshape(b, s, hk, rep + 2, d)
+            q = grouped[..., :rep, :].reshape(b, s, h, d)
+            k = grouped[..., rep, :]
+            v = grouped[..., rep + 1, :]
+        else:
+            q = qkv[..., : h * d].reshape(b, s, h, d)
+            k = qkv[..., h * d: (h + hk) * d].reshape(b, s, hk, d)
+            v = qkv[..., (h + hk) * d:].reshape(b, s, hk, d)
         if cfg.position_embedding == "rope":
             rot = int(cfg.rotary_pct * d) // 2 * 2
             cos, sin = rope_cos_sin(s, rot, base=cfg.rope_base)

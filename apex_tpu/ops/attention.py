@@ -179,11 +179,13 @@ def _scores(q_ref, k_ref, kvb_ref, i, j, *, scale, causal, per_q, bq,
 
     ``per_q``: the bias block is (1, bq, bk) (per-query rows, e.g.
     relative-position bias) instead of the (1, 1, bk) per-key row."""
-    q = q_ref[0].astype(jnp.float32)               # (bq, d)
-    k = k_ref[0].astype(jnp.float32)               # (bk, d)
+    # operands stay in their input dtype (bf16 runs the MXU at full
+    # rate; an fp32 upcast here would cost ~6-8x matmul throughput —
+    # the reference's fused MHA likewise runs half-precision tensor-op
+    # matmuls with fp32 softmax); accumulation is always fp32
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (bq, bk) f32
     if kvb_ref is not None:
         if per_q:
             s = s + kvb_ref[0]                     # (bq, bk) tile
@@ -231,7 +233,6 @@ def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
 
     @pl.when(block_live)
     def _step():
-        v = v_ref[0].astype(jnp.float32)
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
                     causal=causal, per_q=per_q, bq=bq, bk=bk, sq=sq,
                     sk=sk)
@@ -247,8 +248,10 @@ def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
             keep = _dropout_keep_tile(seed_ref, lane, i, j, bq, bk,
                                       rate)
             p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
+        # probs ride the MXU in the value dtype (fp32 softmax, half pv
+        # matmul — reference fused-MHA recipe), accumulate fp32
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
@@ -374,9 +377,6 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
 
     @pl.when(block_live)
     def _step():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, None]               # (bq, 1)
         delta = delta_ref[0, 0][:, None]
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
@@ -385,8 +385,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
         # dead rows have lse == -inf making exp(s - lse) == 1 there;
         # _zero_dead restores exact zeros
         p = _zero_dead(s, jnp.exp(s - lse), causal, has_bias)
+        # half-dtype operands, fp32 accumulation (see _scores)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bq, bk)
         if rate > 0.0:
             # dS = P ∘ (D∘dP - delta): same mask as the forward tile;
@@ -396,7 +397,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
             dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - delta) * scale
         acc_ref[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(j == sk_blocks - 1)
@@ -427,9 +428,6 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
 
     @pl.when(block_live)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
@@ -443,19 +441,19 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
             pd = jnp.where(keep, p * inv, 0.0)     # dropped probs
         else:
             keep, pd = None, p
-        # dv += (P∘D)ᵀ @ do
+        # dv += (P∘D)ᵀ @ do — half-dtype operands, fp32 accumulation
         dv_acc[:] += jax.lax.dot_general(
-            pd, do, (((0,), (0,)), ((), ())),
+            pd.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if rate > 0.0:
             dp = jnp.where(keep, dp * inv, 0.0)
-        ds = p * (dp - delta) * scale              # (bq, bk)
+        ds = p * (dp - delta) * scale              # (bq, bk) f32
         # dk += dsᵀ @ q
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(i == sq_blocks - 1)
@@ -659,7 +657,8 @@ def fused_attention(q, k, v, *, causal: bool = False,
                     bias_requires_grad: bool = False,
                     dropout_rate: float = 0.0,
                     dropout_rng=None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     implementation: Optional[str] = None):
     """Flash multi-head attention (BSHD layout), O(S) memory.
 
@@ -688,6 +687,13 @@ def fused_attention(q, k, v, *, causal: bool = False,
         raise ValueError(
             f"num_kv_heads ({hk}) must divide num_heads ({h})")
     scale = (d ** -0.5) if scale is None else float(scale)
+    # seq-aware default tiles: 512 short (fastest end-to-end at s=512,
+    # BASELINE.md round-2 sweep), 1024 from 16k (21% faster fwd+bwd
+    # measured at 32k — the VMEM-budget ceiling; 2048 blocks OOM)
+    if block_q is None:
+        block_q = 1024 if sq >= 16384 else 512
+    if block_k is None:
+        block_k = 1024 if sk >= 16384 else 512
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     kvb, bias_mode = _normalize_bias(bias, b, h, sq, sk)

@@ -956,6 +956,7 @@ def forward_backward_pipelining_without_interleaving(
     skip_dead_ticks: Optional[bool] = None,
     loss_params: Any = None,
     return_input_cotangents: bool = False,
+    distribute_inputs: bool = True,
 ):
     """Pipelined forward+backward (reference: 1F1B,
     ``fwd_bwd_pipelining_without_interleaving.py``).
@@ -974,6 +975,13 @@ def forward_backward_pipelining_without_interleaving(
     ``aux["input_cotangents"]`` is ``(M, mb, ...)`` — ``dL/dh`` per
     pipeline-input microbatch, replicated over ``axis``.
 
+    ``distribute_inputs=False`` disables the O(M/pp) cyclic microbatch
+    sharding (feed ring) and replicates the inputs over ``axis``
+    instead — GSPMD then moves batch-sharded inputs with an all-gather
+    rather than an all-to-all.  Use when M is small enough that input
+    memory doesn't matter, or on backends whose all-to-all is fragile
+    (XLA:CPU's in-process communicator).
+
     This drives :func:`spmd_pipeline_1f1b` — the explicit
     one-forward-one-backward tick table with O(pp) live activations —
     rather than autodiff over the forward scan (which would stash all
@@ -990,8 +998,11 @@ def forward_backward_pipelining_without_interleaving(
     # shard the microbatch axis over the pipe ranks (cyclic) so
     # per-rank input memory is O(M/pp) — the feed ring inside
     # spmd_pipeline_1f1b streams them to rank 0
-    mbs, mb_spec, distributed = _distribute_microbatches(
-        mbs, m, mesh, axis)
+    if distribute_inputs:
+        mbs, mb_spec, distributed = _distribute_microbatches(
+            mbs, m, mesh, axis)
+    else:
+        mb_spec, distributed = P(), False
 
     has_aux = loss_params is not None or return_input_cotangents
     aux_specs = {}

@@ -303,10 +303,14 @@ def _roofline_fields(compiled, dt):
     (above peak — the clock lied) or contention-suspect (< 25% of the
     bound — a *sustained* slowdown that agreeing windows can't see).
 
-    Sanity rule: ``flags`` non-empty ⇒ do not trust ``value`` without
-    re-measuring; ``roofline_frac`` ≈ 1 means the step runs at the
-    chip's bound for this program (HBM-bound for the BERT step,
-    BASELINE.md).  Only computed on TPU backends.
+    Sanity rule: ``flags`` non-empty ⇒ clock and cost model disagree —
+    do not trust ``value`` without investigating which is lying.
+    ``impossible_above_peak`` can indict either side: a wrong clock
+    (the round-1 failure mode) or an overcounting ``bytes accessed``
+    (XLA double-counts fusion-internal traffic — observed on the fp8
+    A/B, BASELINE.md).  ``roofline_frac`` ≈ 1 on an unflagged capture
+    means the step runs at the chip's bound for this program
+    (HBM-bound for the BERT step).  Only computed on TPU backends.
     """
     import jax
 

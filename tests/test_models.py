@@ -287,7 +287,8 @@ class TestTorchImport:
             0, 128, size=(2, 16)).astype(np.int64)
         params = model.init(jax.random.PRNGKey(0),
                             jnp.asarray(ids_np, jnp.int32))
-        params = load_torch_gpt2(params, tm.state_dict())
+        params = load_torch_gpt2(params, tm.state_dict(),
+                                 num_heads=cfg.num_heads)
 
         with torch.no_grad():
             want = tm(torch.from_numpy(ids_np)).logits.numpy()
@@ -307,7 +308,7 @@ class TestTorchImport:
         params = model.init(jax.random.PRNGKey(0),
                             jnp.zeros((1, 8), jnp.int32))
         with pytest.raises(KeyError, match="wte"):
-            load_torch_gpt2(params, {})
+            load_torch_gpt2(params, {}, num_heads=2)
 
     def test_layer_count_mismatch_raises(self):
         import torch
@@ -326,7 +327,8 @@ class TestTorchImport:
         params = model.init(jax.random.PRNGKey(0),
                             jnp.zeros((1, 8), jnp.int32))
         with pytest.raises(ValueError, match="refusing"):
-            load_torch_gpt2(params, tm.state_dict())
+            load_torch_gpt2(params, tm.state_dict(),
+                            num_heads=2)
 
     def test_registration_conflict_raises(self):
         import types

@@ -137,7 +137,7 @@ class TestFusedAdamFP8Moments:
                 # error is unbounded (update ~ lr * m/sqrt(v))
                 np.testing.assert_allclose(
                     np.asarray(b), np.asarray(a),
-                    rtol=0.35, atol=5e-4), i
+                    rtol=0.35, atol=5e-4, err_msg=f"step {i}")
 
     def test_trains_a_model(self, rng):
         # end-to-end: a tiny regression model reaches a loss close to
