@@ -41,6 +41,7 @@ def build_model(
     rng,
     sample_input,
     axis: str = PIPE_AXIS,
+    layer_remat: bool = False,
 ) -> Tuple[Callable, Any, Any]:
     """Build ``(stage_fn, stacked_params, params_spec)`` for the
     pipeline schedules.
@@ -68,6 +69,14 @@ def build_model(
         in_spec restricted to the manual pipe axis, and their defaults
         (``P(axis)`` / ``P(None, axis)``) already match this layout —
         the tensor-axis sharding rides along via GSPMD.
+
+    ``layer_remat=True`` wraps each layer application in
+    ``jax.checkpoint``: differentiating a stage then holds ONE layer's
+    residuals at a time instead of all ``layers_per_stage`` — the
+    deep-stage analogue of the 1F1B schedule's stage-input
+    remat-by-construction (its backward unit recomputes the stage
+    interior, which without this flag materializes every layer's
+    residuals at once).
     """
     import flax.linen as nn
 
@@ -109,8 +118,13 @@ def build_model(
         is_leaf=lambda x: isinstance(x, P))
 
     def stage_fn(stage_params, x):
+        apply = lambda lp, h: layer_module.apply(lp, h)
+        if layer_remat:
+            apply = jax.checkpoint(
+                apply, policy=jax.checkpoint_policies.nothing_saveable)
+
         def body(h, layer_params):
-            return layer_module.apply(layer_params, h), None
+            return apply(layer_params, h), None
 
         y, _ = lax.scan(body, x, stage_params)
         return y

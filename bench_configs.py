@@ -389,7 +389,9 @@ def bench_gpt2_3d_full_step():
         pipeline_model_parallel_size=2,
         data_parallel_size=2)
     gcfg = _gpt_cfg(24, scan=False)
-    s = int(os.environ.get("BENCH_SEQ", "512"))
+    # s=256 keeps the peak inside the 125 GB host (the model is the
+    # full 1.3B either way; only the token count is small)
+    s = int(os.environ.get("BENCH_SEQ", "256"))
     m, mb = 2, 2
     cfg = TransformerConfig(
         vocab_size=gcfg.vocab_size, hidden_size=gcfg.hidden_size,
@@ -401,7 +403,12 @@ def bench_gpt2_3d_full_step():
     x0 = jnp.zeros((mb, s, cfg.hidden_size), jnp.float32)
     stage_fn, stages, stage_spec = build_model(
         layer, num_layers=24, pipeline_model_parallel_size=2,
-        rng=jax.random.PRNGKey(0), sample_input=x0)
+        rng=jax.random.PRNGKey(0), sample_input=x0,
+        # one layer's residuals at a time when the 1F1B backward unit
+        # recomputes its 12-layer stage — without this the per-tick vjp
+        # holds all 12 layers' residuals (~24 GB across the 8 virtual
+        # devices) and the leg OOMs the 125 GB host
+        layer_remat=True)
 
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, size=(m * mb, s + 1))
@@ -419,9 +426,13 @@ def bench_gpt2_3d_full_step():
             jnp.float32)
         params = {"embed": embed, "pos": pos, "stages": stages,
                   "head": head}
+        # bf16 moments (as the gpt2_1p3b proxy leg): XLA:CPU does not
+        # honor buffer donation, so the step materializes a second
+        # optimizer state — fp32 moments put the peak past 125 GB
         state = amp.initialize(
-            None, params, fused_adam(1e-4), opt_level="O2",
-            half_dtype=half)
+            None, params,
+            fused_adam(1e-4, moment_dtype=jnp.bfloat16),
+            opt_level="O2", half_dtype=half)
 
         # placement: stages sharded per build_model's spec; embed/head
         # masters+moments ZeRO-sharded over (data, tensor) — on 8
@@ -431,12 +442,21 @@ def bench_gpt2_3d_full_step():
         emb_spec = {"embed": P(("data", "tensor"), None), "pos": P(),
                     "head": P(None, ("data", "tensor"))}
 
+        # storage spec: additionally ZeRO-shard the per-stage axis over
+        # `data` (distributed_fused_adam semantics) — XLA:CPU does not
+        # honor donation, so the step materializes a second state and
+        # the un-data-sharded x2 replication would put the peak past
+        # the 125 GB host
+        stage_storage = jax.tree.map(
+            lambda sp: P(sp[0], "data", *sp[2:]), stage_spec,
+            is_leaf=lambda v: isinstance(v, P))
+
         def place(tree):
             out = dict(tree)
             out["stages"] = jax.tree.map(
                 lambda sp, l: jax.device_put(
                     l, NamedSharding(mesh, sp)),
-                stage_spec, tree["stages"],
+                stage_storage, tree["stages"],
                 is_leaf=lambda v: isinstance(v, P))
             for k, sp in emb_spec.items():
                 out[k] = jax.device_put(
@@ -449,6 +469,13 @@ def bench_gpt2_3d_full_step():
             opt_state=opt._replace(
                 exp_avg=place(opt.exp_avg),
                 exp_avg_sq=place(opt.exp_avg_sq)))
+        # free the pre-placement unsharded copies (~20 GB of zombies:
+        # build_model's stacked stages, amp.initialize's master copy
+        # and moment inits all stay alive through these references)
+        del stages, params, opt, embed, pos, head
+        import gc
+
+        gc.collect()
         # token ids/labels replicated: with them data-sharded, GSPMD
         # emits all-to-alls (in-tick label indexing, embedding
         # scatter-add) and XLA:CPU's in-process AllToAll thunk
@@ -742,15 +769,14 @@ def bench_vit_huge_lamb():
 # ----------------------------------------------------------------- groupnorm
 
 def bench_group_norm():
-    """GroupNorm+SiLU datapoint (round-2 verdict weak #6): the
-    reference ships a dedicated NHWC group_norm CUDA kernel for
-    diffusion workloads; ours is an XLA composition
-    (``ops/group_norm.py``) on the rationale that a purely
-    bandwidth-bound op can't beat the compiler.  This leg tests that
-    rationale with numbers: fwd+bwd GN(32 groups)+SiLU over a
-    diffusion-typical activation, achieved HBM GB/s vs the chip's
-    peak.  If the composition already runs near the bandwidth
-    roofline, a Pallas kernel has no headroom."""
+    """GroupNorm+SiLU scoreboard (round-2 verdict weak #6): fwd+bwd
+    GN(32 groups)+SiLU over a diffusion-typical activation, achieved
+    HBM GB/s vs the chip's peak, measured with the DEFAULT
+    implementation — the round-3 Pallas kernels on TPU (the round-2
+    XLA composition measured 70 GB/s ≈ 9% of peak here, which refuted
+    the original no-kernel rationale; the kernel A/B lives in
+    BASELINE.md).  Set APEX_TPU_OPS_IMPL=xla to re-measure the
+    composition."""
     import time
 
     import jax

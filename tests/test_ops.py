@@ -269,6 +269,52 @@ class TestGroupNorm:
         with pytest.raises(ValueError):
             ops.group_norm(_x(rng, (1, 2, 2, 10)), 3)
 
+    @pytest.mark.parametrize("act", [None, "silu"])
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_pallas_kernel_matches_reference(self, rng, act, dtype):
+        """Round-3 Pallas GN fwd+bwd vs the XLA golden (the round-2
+        composition): values and all three grads."""
+        from apex_tpu.ops.group_norm import group_norm_reference
+
+        n, hh, ww, c, g = 2, 8, 8, 256, 8
+        x = jnp.asarray(rng.normal(size=(n, hh, ww, c)), dtype)
+        w = jnp.asarray(rng.normal(size=(c,)) * 0.5 + 1.0, jnp.float32)
+        b = jnp.asarray(rng.normal(size=(c,)) * 0.1, jnp.float32)
+        bf16 = dtype == jnp.bfloat16
+        rtol, atol = (3e-2, 3e-2) if bf16 else (2e-5, 1e-5)
+
+        got = ops.group_norm(x, g, w, b, act=act,
+                             implementation="pallas_interpret")
+        want = group_norm_reference(x, g, w, b, act=act)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=rtol, atol=atol)
+
+        def grads(fn):
+            def f(x, w, b):
+                return jnp.sum(fn(x, w, b).astype(jnp.float32) ** 2)
+            return jax.grad(f, argnums=(0, 1, 2))(x, w, b)
+
+        gp = grads(lambda x, w, b: ops.group_norm(
+            x, g, w, b, act=act, implementation="pallas_interpret"))
+        gr = grads(lambda x, w, b: group_norm_reference(
+            x, g, w, b, act=act))
+        rtol, atol = (4e-2, 4e-2) if bf16 else (5e-5, 1e-4)
+        for a, bb in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(bb, np.float32),
+                                       rtol=rtol, atol=atol)
+
+    def test_odd_spatial_falls_back(self, rng):
+        # (3, 3) spatial: no 8-aligned divisor -> XLA path; still exact
+        from apex_tpu.ops.group_norm import group_norm_reference
+
+        x = _x(rng, (2, 3, 3, 128))
+        got = ops.group_norm(x, 4)
+        want = group_norm_reference(x, 4)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
 
 class TestAutotune:
     """Sweep-and-cache block-size autotuner (round-1 verdict weak 7:
