@@ -426,6 +426,7 @@ def bench_gpt2_3d_full_step():
             jnp.float32)
         params = {"embed": embed, "pos": pos, "stages": stages,
                   "head": head}
+        n_params = sum(x.size for x in jax.tree.leaves(params))
         # bf16 moments (as the gpt2_1p3b proxy leg): XLA:CPU does not
         # honor buffer donation, so the step materializes a second
         # optimizer state — fp32 moments put the peak past 125 GB
@@ -533,7 +534,6 @@ def bench_gpt2_3d_full_step():
         state, loss, finite = step(state, inputs, labels)
         loss = float(loss)
         dt = time.perf_counter() - t0
-    n_params = sum(x.size for x in jax.tree.leaves(params))
     assert np.isfinite(loss), f"non-finite loss {loss}"
     _emit({
         "metric": "gpt2_1p3b_tp2pp2dp2_1f1b_train_step_executed",
