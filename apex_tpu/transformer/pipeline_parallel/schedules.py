@@ -129,6 +129,34 @@ def _unit(skip, pred, live_fn, dead_fn, operands):
     return jax.tree.map(lambda a, b: jnp.where(pred, a, b), live, dead)
 
 
+def _closure_aux_specs(loss_params, return_input_cotangents):
+    """shard_map out_specs for the embedding/head-closure aux dict."""
+    aux = {}
+    if loss_params is not None:
+        aux["loss_params_grads"] = jax.tree.map(
+            lambda _: P(), loss_params)
+    if return_input_cotangents:
+        aux["input_cotangents"] = P()
+    return aux
+
+
+def _closure_aux_collect(extras, loss_params, return_input_cotangents,
+                         axis):
+    """Replicate the rank-local closure extras over ``axis``:
+    loss-param grads fired on the last rank only (psum = the sum);
+    input cotangents live on rank 0 (masked psum = broadcast)."""
+    aux = {}
+    if loss_params is not None:
+        aux["loss_params_grads"] = jax.tree.map(
+            lambda g: lax.psum(g, axis), extras["loss_params_grads"])
+    if return_input_cotangents:
+        cts = extras["input_cotangents"]
+        aux["input_cotangents"] = lax.psum(
+            jnp.where(lax.axis_index(axis) == 0, cts,
+                      jnp.zeros_like(cts)), axis)
+    return aux
+
+
 def _after(first, x):
     """Return ``x`` ordered after ``first`` (``optimization_barrier``).
 
@@ -521,6 +549,8 @@ def spmd_pipeline_1f1b_interleaved(
     axis: str = PIPE_AXIS,
     microbatches_distributed: bool = False,
     skip_dead_ticks: Optional[bool] = None,
+    loss_params: Any = None,
+    return_input_cotangents: bool = False,
 ):
     """Interleaved (virtual-pipeline) one-forward-one-backward schedule
     computing ``(loss, grads)`` with O(pp·V) live activations.
@@ -564,6 +594,11 @@ def spmd_pipeline_1f1b_interleaved(
     inject their next local microbatch every ``V·pp`` ticks, the feed
     shifts one hop toward rank 0 for the first ``pp`` ticks of each
     window and idles the rest.  Per-rank input memory O(M/pp).
+
+    ``loss_params`` / ``return_input_cotangents``: embedding/head
+    closure exactly as in :func:`spmd_pipeline_1f1b` — microbatch
+    ``m``'s pipeline-input cotangent exits at rank 0's chunk-0
+    backward and is stored into an O(M) carry buffer at slot ``m``.
     """
     pp = lax.axis_size(axis)
     rank = lax.axis_index(axis)
@@ -603,16 +638,24 @@ def spmd_pipeline_1f1b_interleaved(
         # see _unit: cond-skipping requires collective-free bodies
         chunk0 = jax.tree.map(
             lambda a: a[0] if a.ndim else a, params_local)
+        if loss_params is None:
+            loss_probe = lambda y: loss_fn(y, jnp.int32(0))
+        else:
+            loss_probe = lambda y: loss_fn(loss_params, y, jnp.int32(0))
         skip_dead_ticks = not (
             _traces_collectives(stage_fn, chunk0, mb_shape)
-            or _traces_collectives(
-                lambda y: loss_fn(y, jnp.int32(0)), mb_shape))
+            or _traces_collectives(loss_probe, mb_shape))
 
     def varying(x):
         try:
             return lax.pcast(x, (axis,), to="varying")
         except ValueError:
             return x
+
+    # see spmd_pipeline_1f1b: a pipe-invariant loss_params would make
+    # the vjp transpose insert a psum inside the loss cond
+    if loss_params is not None:
+        loss_params = jax.tree.map(varying, loss_params)
 
     def chunk_params(c):
         return jax.tree.map(
@@ -622,7 +665,7 @@ def spmd_pipeline_1f1b_interleaved(
 
     def tick(carry, t):
         (fwd_x, bwd_ct, pending_ct, feed, stash, loss_acc,
-         grad_acc) = carry
+         grad_acc, lp_grad_acc, ct_buf) = carry
 
         # ---- forward unit: item if = t - rank ----
         i_f = t - rank
@@ -655,22 +698,37 @@ def spmd_pipeline_1f1b_interleaved(
 
         # ---- loss + output-cotangent on the last rank, last lap ----
         def loss_and_ct(y):
-            lval, pull = jax.vjp(lambda yy: loss_fn(yy, m_f), y)
+            if loss_params is None:
+                lval, pull = jax.vjp(lambda yy: loss_fn(yy, m_f), y)
+            else:
+                lval, pull = jax.vjp(
+                    lambda lp, yy: loss_fn(lp, yy, m_f), loss_params, y)
             seed = varying(
                 (jnp.float32(1) / num_micro).astype(lval.dtype))
-            (ct,) = pull(seed)
-            return varying(lval.astype(jnp.float32)), varying(ct)
+            if loss_params is None:
+                (ct,) = pull(seed)
+                glp = ()
+            else:
+                glp, ct = pull(seed)
+            return (varying(lval.astype(jnp.float32)), varying(ct),
+                    jax.tree.map(varying, glp))
 
         is_last = rank == pp - 1
         fire_loss = valid_f & is_last & (c_f == v - 1)
-        lval, maybe_pending = _unit(
+        lval, maybe_pending, glp = _unit(
             skip_dead_ticks, fire_loss, loss_and_ct,
             lambda y: (varying(jnp.zeros((), jnp.float32)),
-                       varying(jnp.zeros_like(y))), y)
+                       varying(jnp.zeros_like(y)),
+                       jax.tree.map(
+                           lambda a: varying(jnp.zeros_like(a)),
+                           () if loss_params is None else loss_params)),
+            y)
         # only overwrite the pending slot when a loss actually fired —
         # it is consumed exactly one tick later, before the next fire
         new_pending = jnp.where(fire_loss, maybe_pending, pending_ct)
         loss_acc = loss_acc + lval
+        if loss_params is not None:
+            lp_grad_acc = jax.tree.map(jnp.add, lp_grad_acc, glp)
 
         # ---- backward unit: ρ = t - v·pp - (pp-1-rank) ----
         rho = t - v * pp - (pp - 1 - rank)
@@ -732,8 +790,18 @@ def spmd_pipeline_1f1b_interleaved(
             feed = jnp.where(
                 win == 0, local_next,
                 jnp.where(win < pp, shifted, feed))
+        if return_input_cotangents:
+            # rank 0's chunk-0 backward carries dL/d(pipeline input);
+            # store at its microbatch slot — an O(M) carry buffer, not
+            # an O(n_ticks) = O(V·M) scan stack
+            m_b = g_b * pp + j_b
+            upd = lax.dynamic_update_index_in_dim(
+                ct_buf, gx.astype(ct_buf.dtype),
+                jnp.clip(m_b, 0, num_micro - 1), axis=0)
+            ct_buf = jnp.where(
+                (rank == 0) & (c_b == 0) & valid_b, upd, ct_buf)
         return (fwd_x, bwd_ct, new_pending, feed, stash, loss_acc,
-                grad_acc), None
+                grad_acc, lp_grad_acc, ct_buf), None
 
     feed0 = (varying(microbatches[0]) if microbatches_distributed
              else varying(jnp.zeros((), mb_shape.dtype)))
@@ -746,10 +814,23 @@ def spmd_pipeline_1f1b_interleaved(
                           mb_shape.dtype)),                 # stash
         varying(jnp.zeros((), jnp.float32)),                # loss acc
         jax.tree.map(jnp.zeros_like, params_local),          # grad acc
+        jax.tree.map(lambda a: varying(jnp.zeros_like(a)),
+                     () if loss_params is None else loss_params),
+        varying(jnp.zeros(                                  # ct buffer
+            ((num_micro,) if return_input_cotangents else (0,))
+            + mb_shape.shape, mb_shape.dtype)),
     )
     carry, _ = lax.scan(tick, init, jnp.arange(n_ticks))
-    loss_acc, grad_acc = carry[-2], carry[-1]
-    return loss_acc, grad_acc
+    loss_acc, grad_acc, lp_grad_acc, ct_buf = (
+        carry[-4], carry[-3], carry[-2], carry[-1])
+    if loss_params is None and not return_input_cotangents:
+        return loss_acc, grad_acc
+    extras = {}
+    if loss_params is not None:
+        extras["loss_params_grads"] = lp_grad_acc
+    if return_input_cotangents:
+        extras["input_cotangents"] = ct_buf
+    return loss_acc, grad_acc, extras
 
 
 # --------------------------------------------------------------------- #
@@ -1005,12 +1086,7 @@ def forward_backward_pipelining_without_interleaving(
         mb_spec, distributed = P(), False
 
     has_aux = loss_params is not None or return_input_cotangents
-    aux_specs = {}
-    if loss_params is not None:
-        aux_specs["loss_params_grads"] = jax.tree.map(
-            lambda _: P(), loss_params)
-    if return_input_cotangents:
-        aux_specs["input_cotangents"] = P()
+    aux_specs = _closure_aux_specs(loss_params, return_input_cotangents)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -1041,19 +1117,8 @@ def forward_backward_pipelining_without_interleaving(
             grads_local, params_local)
         if not has_aux:
             return loss, grads
-        extras = out[2]
-        aux = {}
-        if loss_params is not None:
-            # fired on rank pp-1 only; psum replicates the sum
-            aux["loss_params_grads"] = jax.tree.map(
-                lambda g: lax.psum(g, axis),
-                extras["loss_params_grads"])
-        if return_input_cotangents:
-            cts = extras["input_cotangents"]
-            aux["input_cotangents"] = lax.psum(
-                jnp.where(lax.axis_index(axis) == 0, cts,
-                          jnp.zeros_like(cts)), axis)
-        return loss, grads, aux
+        return loss, grads, _closure_aux_collect(
+            out[2], loss_params, return_input_cotangents, axis)
 
     return run(stage_params, mbs)
 
@@ -1070,6 +1135,8 @@ def forward_backward_pipelining_with_interleaving(
     remat: bool = True,
     params_spec: Optional[Any] = None,
     skip_dead_ticks: Optional[bool] = None,
+    loss_params: Any = None,
+    return_input_cotangents: bool = False,
 ):
     """Interleaved pipelined forward+backward (reference:
     ``fwd_bwd_pipelining_with_interleaving.py``).
@@ -1086,6 +1153,10 @@ def forward_backward_pipelining_with_interleaving(
     stash all ``M·V + pp - 1`` tick outputs).  ``remat`` is accepted
     for API stability but has no effect: each backward unit recomputes
     its stage interior from the stashed input by construction.
+
+    ``loss_params`` / ``return_input_cotangents``: embedding/head
+    closure with the same semantics and ``aux`` shape as
+    :func:`forward_backward_pipelining_without_interleaving`.
     """
     del remat  # remat-by-construction (see docstring)
     m = num_microbatches or get_num_microbatches()
@@ -1097,17 +1168,25 @@ def forward_backward_pipelining_with_interleaving(
     mbs, mb_spec, distributed = _distribute_microbatches(
         mbs, m, mesh, axis)
 
+    has_aux = loss_params is not None or return_input_cotangents
+    aux_specs = _closure_aux_specs(loss_params, return_input_cotangents)
+
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(pspec, mb_spec), out_specs=(P(), pspec),
+        in_specs=(pspec, mb_spec),
+        out_specs=((P(), pspec, aux_specs) if has_aux
+                   else (P(), pspec)),
         axis_names={axis})
     def run(params_local, mbs_local):
         if distributed:
             mbs_local = mbs_local[0]     # strip the split pp dim
-        loss_local, grads_local = spmd_pipeline_1f1b_interleaved(
+        out = spmd_pipeline_1f1b_interleaved(
             stage_fn, loss_fn, params_local, mbs_local, axis=axis,
             microbatches_distributed=distributed,
-            skip_dead_ticks=skip_dead_ticks)
+            skip_dead_ticks=skip_dead_ticks,
+            loss_params=loss_params,
+            return_input_cotangents=return_input_cotangents)
+        loss_local, grads_local = out[0], out[1]
         loss = lax.psum(loss_local, axis) / m
         # restore the stripped split-pp axis for the out_spec: local
         # grads are (V, ...); the spec expects (V, 1, ...).  0-d
@@ -1115,7 +1194,10 @@ def forward_backward_pipelining_with_interleaving(
         grads = jax.tree.map(
             lambda g, a: g[:, None] if a.ndim else lax.psum(g, axis),
             grads_local, params_local)
-        return loss, grads
+        if not has_aux:
+            return loss, grads
+        return loss, grads, _closure_aux_collect(
+            out[2], loss_params, return_input_cotangents, axis)
 
     return run(stage_params, mbs)
 

@@ -504,9 +504,17 @@ class TestEmbeddingHeadClosure:
     the full composed step's grads must equal plain autodiff of the
     same composition."""
 
-    def test_matches_autodiff(self, rng, mesh8):
+    @pytest.mark.parametrize("v", [None, 2])
+    def test_matches_autodiff(self, rng, mesh8, v):
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_with_interleaving)
+
         m, voc = 4, 32
-        stacked = _stacked_params(rng, 2)
+        stacked = (_stacked_params(rng, 2) if v is None
+                   else _stacked_params_vpp(rng, v, 2))
+        driver = (forward_backward_pipelining_without_interleaving
+                  if v is None
+                  else forward_backward_pipelining_with_interleaving)
         embed = jnp.asarray(rng.normal(size=(voc, HID)) * 0.5,
                             jnp.float32)
         head = jnp.asarray(rng.normal(size=(HID, voc)) * 0.5,
@@ -529,11 +537,10 @@ class TestEmbeddingHeadClosure:
         with jax.set_mesh(mesh8):
             def pipeline_full(stacked, embed, head):
                 h = jnp.take(embed, ids, axis=0)
-                loss, sgrads, aux = \
-                    forward_backward_pipelining_without_interleaving(
-                        _stage_fn, loss_fn, stacked, h, mesh=mesh8,
-                        num_microbatches=m, loss_params=(head,),
-                        return_input_cotangents=True)
+                loss, sgrads, aux = driver(
+                    _stage_fn, loss_fn, stacked, h, mesh=mesh8,
+                    num_microbatches=m, loss_params=(head,),
+                    return_input_cotangents=True)
                 cts = aux["input_cotangents"].reshape(m * MB, SEQ, HID)
                 d_embed = jnp.zeros_like(embed).at[ids].add(cts)
                 (d_head,) = aux["loss_params_grads"]
@@ -548,9 +555,12 @@ class TestEmbeddingHeadClosure:
 
             def one(mb_i, i):
                 x = mb_i
-                for s in range(2):
-                    x = _stage_fn(
-                        jax.tree.map(lambda t: t[s], stacked), x)
+                for c in range(v or 1):
+                    for r in range(2):
+                        sp = jax.tree.map(
+                            lambda t: t[r] if v is None else t[c, r],
+                            stacked)
+                        x = _stage_fn(sp, x)
                 return loss_fn((head,), x, i)
 
             return jnp.mean(jax.vmap(one)(h, jnp.arange(m)))
