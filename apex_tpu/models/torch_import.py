@@ -60,9 +60,22 @@ def _set_leaf(leaf, value: np.ndarray):
     return jnp.asarray(value, leaf.dtype)
 
 
-def _qkv_flat_to_grouped(w: np.ndarray, num_heads: int) -> np.ndarray:
+def _qkv_flat_to_grouped(w: np.ndarray, num_heads: int,
+                         num_kv_heads: int | None = None) -> np.ndarray:
     """Permute a flat ``[q|k|v]`` output axis (HF c_attn) into the
-    per-head-grouped ``[q_i k_i v_i]`` layout of ``qkv_proj``."""
+    per-head-grouped ``[q_i k_i v_i]`` layout of ``qkv_proj``.
+
+    Only the MHA layout (``num_kv_heads == num_heads``) is implemented:
+    GPT-2 checkpoints are always MHA.  A GQA flat layout (fewer kv than
+    q heads) needs a different ``[q_g*rep.., k_g, v_g]`` permutation —
+    guarded here so mismatched weights can never be silently imported.
+    """
+    if num_kv_heads is not None and num_kv_heads != num_heads:
+        raise NotImplementedError(
+            f"_qkv_flat_to_grouped only implements the MHA layout; got "
+            f"num_kv_heads={num_kv_heads} != num_heads={num_heads}. "
+            f"Import GQA checkpoints with qkv_grouped=False or add the "
+            f"grouped-GQA permutation.")
     out = w.shape[-1]
     if out % (3 * num_heads):
         raise ValueError(
@@ -94,7 +107,8 @@ def _layer_mapping(i: int) -> dict:
 
 
 def load_torch_gpt2(params: Any, state_dict: Mapping[str, Any], *,
-                    num_heads: int, qkv_grouped: bool = True) -> Any:
+                    num_heads: int, num_kv_heads: int | None = None,
+                    qkv_grouped: bool = True) -> Any:
     """Map an HF GPT-2 state dict onto a GPTModel ``params`` pytree.
 
     ``params``: the (possibly ``init``-fresh) variables dict or its
@@ -104,7 +118,9 @@ def load_torch_gpt2(params: Any, state_dict: Mapping[str, Any], *,
     ``transformer.``-prefixed and unprefixed key forms both work).
     ``num_heads``: the model's attention head count — needed to permute
     c_attn's flat [q|k|v] columns into qkv_proj's per-head-grouped
-    layout.  ``qkv_grouped`` must match the model's
+    layout.  ``num_kv_heads``: pass the model's kv-head count when it
+    differs from ``num_heads`` — the grouped GQA permutation is not
+    implemented, so a mismatch raises instead of silently mispermuting.  ``qkv_grouped`` must match the model's
     ``TransformerConfig.qkv_grouped`` (pass ``False`` for models built
     with the flat layout, e.g. single-chip long-context configs).
     """
@@ -125,7 +141,7 @@ def load_torch_gpt2(params: Any, state_dict: Mapping[str, Any], *,
         val = _to_np(sd[key])
         if qkv_grouped and (key.endswith("attn.c_attn.weight")
                             or key.endswith("attn.c_attn.bias")):
-            val = _qkv_flat_to_grouped(val, num_heads)
+            val = _qkv_flat_to_grouped(val, num_heads, num_kv_heads)
         return val
 
     def put(path, key):

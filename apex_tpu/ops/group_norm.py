@@ -250,6 +250,11 @@ def _gn_call_fwd(x3, gmat, w2, b2, eps, silu, br, cg, interpret):
             pltpu.VMEM((1, c), jnp.float32),
             pltpu.VMEM((1, c), jnp.float32),
         ],
+        # the two-phase stats/normalize split carries VMEM scratch
+        # across grid steps — pin every grid dim sequential so a future
+        # megacore/parallel-dims default can't silently break it
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(x3, gmat, w2, b2)
     return y, mc, rc
@@ -299,6 +304,11 @@ def _gn_call_bwd(dy3, x3, gmat, w2, b2, mc, rc, silu, br, cg, interpret):
             pltpu.VMEM((1, c), jnp.float32),
             pltpu.VMEM((1, c), jnp.float32),
         ],
+        # dgamma/dbeta accumulate in scratch across the ENTIRE (N,2,rb)
+        # grid and are written on the last step — correctness requires
+        # sequential grid execution; pin it explicitly
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(dy3, x3, gmat, w2, b2, mc, rc)
     return dx, dw, db

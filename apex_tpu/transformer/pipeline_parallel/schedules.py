@@ -42,6 +42,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -95,14 +96,36 @@ def _contains_collectives(jaxpr) -> bool:
 
 
 def _traces_collectives(fn, *args) -> bool:
-    """True if tracing ``fn(*args)`` records any collective primitive
-    (explicit ``lax.p*`` or a sharding constraint that GSPMD may lower
-    to one).  Unable-to-trace counts as True (the safe answer)."""
+    """True if tracing ``fn(*args)`` — forward OR its vjp pullback —
+    records any collective primitive (explicit ``lax.p*`` or a sharding
+    constraint that GSPMD may lower to one).  The pullback is probed
+    separately because a collective can appear only in the backward
+    (e.g. a ``custom_vjp`` whose bwd rule psums, or a transpose that
+    inserts ``psum_invariant``); a forward-only probe would classify
+    such a stage collective-free, cond-skip it, and deadlock on
+    rank-divergent backward units.  Unable-to-trace counts as True
+    (the safe answer: computed-and-masked mode is always sound)."""
     try:
         jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
     except Exception:
         return True
-    return _contains_collectives(jaxpr)
+    if _contains_collectives(jaxpr):
+        return True
+
+    def _ct_like(x):
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            return jnp.zeros(x.shape, x.dtype)
+        return np.zeros(x.shape, jax.dtypes.float0)
+
+    def probe(*a):
+        y, pullback = jax.vjp(fn, *a)
+        return pullback(jax.tree.map(_ct_like, y))
+
+    try:
+        bwd_jaxpr = jax.make_jaxpr(probe)(*args).jaxpr
+    except Exception:
+        return True
+    return _contains_collectives(bwd_jaxpr)
 
 
 def _unit(skip, pred, live_fn, dead_fn, operands):
@@ -400,7 +423,7 @@ def spmd_pipeline_1f1b(
 
     def tick(carry, t):
         (fwd_x, bwd_ct, pending_ct, feed, stash, loss_acc, grad_acc,
-         lp_grad_acc) = carry
+         lp_grad_acc, ct_buf) = carry
 
         # ---- forward unit: microbatch mf = t - rank ----
         mf = t - rank
@@ -497,13 +520,18 @@ def spmd_pipeline_1f1b(
                 _after(bwd_ct, feed), axis,
                 [(i, (i - 1) % pp) for i in range(pp)])
             feed = jnp.where((t + 1) % pp == 0, local_next, shifted)
-        emit = None
         if return_input_cotangents:
             # rank 0's input-cotangent = dL/d(pipeline input) for
-            # microbatch mb_b; zeros on other ranks / dead units
-            emit = jnp.where(rank == 0, gx, jnp.zeros_like(gx))
+            # microbatch mb_b; store at its microbatch slot — an O(M)
+            # carry buffer, not an O(n_ticks) scan stack (which would
+            # add (2pp-1) microbatch-sized slots, replicated on every
+            # rank, of zeros)
+            upd = lax.dynamic_update_index_in_dim(
+                ct_buf, gx.astype(ct_buf.dtype),
+                jnp.clip(mb_b, 0, num_micro - 1), axis=0)
+            ct_buf = jnp.where((rank == 0) & valid_b, upd, ct_buf)
         return (fwd_x, bwd_ct, new_pending, feed, stash, loss_acc,
-                grad_acc, lp_grad_acc), emit
+                grad_acc, lp_grad_acc, ct_buf), None
 
     feed0 = (varying(microbatches[0]) if microbatches_distributed
              else varying(jnp.zeros((), mb_shape.dtype)))
@@ -522,18 +550,20 @@ def spmd_pipeline_1f1b(
         # the last rank accumulates)
         jax.tree.map(lambda a: varying(jnp.zeros_like(a)),
                      () if loss_params is None else loss_params),
+        varying(jnp.zeros(                                  # ct buffer
+            ((num_micro,) if return_input_cotangents else (0,))
+            + mb_shape.shape, mb_shape.dtype)),
     )
-    carry, ys = lax.scan(tick, init, jnp.arange(n_ticks))
-    loss_acc, grad_acc, lp_grad_acc = carry[-3], carry[-2], carry[-1]
+    carry, _ = lax.scan(tick, init, jnp.arange(n_ticks))
+    loss_acc, grad_acc, lp_grad_acc, ct_buf = (
+        carry[-4], carry[-3], carry[-2], carry[-1])
     if loss_params is None and not return_input_cotangents:
         return loss_acc, grad_acc
     extras = {}
     if loss_params is not None:
         extras["loss_params_grads"] = lp_grad_acc
     if return_input_cotangents:
-        # rank 0's backward for microbatch mb runs at tick mb + 2pp-1
-        extras["input_cotangents"] = ys[2 * pp - 1:
-                                        2 * pp - 1 + num_micro]
+        extras["input_cotangents"] = ct_buf
     return loss_acc, grad_acc, extras
 
 

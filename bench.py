@@ -318,10 +318,17 @@ def _roofline_fields(compiled, dt):
         return {}
     try:
         ca = compiled.cost_analysis() or {}
+        # older runtimes returned a list of per-program dicts — sum
+        # them (taking only [0] would silently undercount multi-program
+        # executables)
+        if isinstance(ca, (list, tuple)):
+            flops = sum(float(c.get("flops", 0.0)) for c in ca)
+            byts = sum(float(c.get("bytes accessed", 0.0)) for c in ca)
+        else:
+            flops = float(ca.get("flops", 0.0))
+            byts = float(ca.get("bytes accessed", 0.0))
     except Exception:
         return {}
-    flops = float(ca.get("flops", 0.0))
-    byts = float(ca.get("bytes accessed", 0.0))
     if not flops or not dt:
         return {}
     achieved = flops / dt / 1e12
