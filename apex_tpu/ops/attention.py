@@ -8,17 +8,27 @@ pre-flash-era fused attention (SURVEY.md §2.7, "north-star op").
 TPU design — a single flash-attention family subsumes the whole kernel
 zoo, exactly as flash attention subsumed them upstream:
 
-- **forward**: grid ``(batch*heads, q_blocks, kv_blocks)``; the TPU
-  executes the last grid axis sequentially, so VMEM scratch carries the
-  online-softmax state (running max ``m``, normalizer ``l``, fp32
-  accumulator) across kv steps; softmax statistics (logsumexp) are
-  written out for the backward.  O(S) memory — the fmha/multihead_attn
-  kernels' O(S²) score tensor never materializes.
+- **forward**: grid ``(batch*heads, q_blocks, kv_blocks)`` — or, on
+  the causal-LM hot path (sq == sk, square blocks), the triangular
+  ``(batch*heads, t)`` grid that enumerates ONLY the live tiles (see
+  ``_tri_ij``; no dead-tile visits, no predicated body).  The TPU
+  executes the trailing grid axis sequentially, so VMEM scratch
+  carries the online-softmax state (running max ``m``, normalizer
+  ``l``, fp32 accumulator) across kv steps.  O(S) memory — the
+  fmha/multihead_attn kernels' O(S²) score tensor never materializes.
+- score tiles are TRANSPOSED (kv on sublanes, q on lanes) and the
+  softmax runs in the log2 domain — both measured wins on the v5e
+  VPU/MXU (see ``_scores``); the saved per-query statistics residual
+  is the LOG2-domain logsumexp ``lse2 = m2 + log2(l)`` and never
+  leaves the fwd/bwd kernel pair.
 - **backward**: ``delta = rowsum(dO·O)`` (XLA), then two Pallas kernels:
   ``dq`` accumulates over kv blocks; ``dk/dv`` accumulate over q blocks —
-  probabilities recomputed from the saved logsumexp (flash-2 style).
-- causal masking is generated in-kernel from block indices; fully-masked
-  kv blocks are skipped via ``pl.when`` (block-sparse fast path).
+  probabilities recomputed from the saved lse2 (flash-2 style), with
+  (d, ·)-shaped accumulators so every accumulation matmul contracts
+  over the big dim at full MXU rate.
+- causal masking is generated in-kernel from block indices; on the
+  rectangular (non-tri) grids, fully-masked kv blocks are skipped via
+  ``pl.when``.
 
 Layout: ``(batch, seq, heads, head_dim)`` (BSHD).  MQA/GQA: pass k/v
 with fewer heads and ``num_kv_heads`` dividing ``num_heads``.
@@ -89,9 +99,12 @@ def _keep_from_counters(seed_u32, lane_u32, q_pos, k_pos, rate):
 
 
 def _dropout_keep_tile(seed_ref, lane, i, j, bq, bk, rate):
-    """(bq, bk) keep-mask for grid tile (lane, i, j) — in-kernel form."""
-    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    """(bk, bq) keep-mask for grid tile (lane, i, j) — the in-kernel
+    (transposed-score-tile) form of the same counter hash; the mask
+    value at (k row, q lane) is hash(q_pos, k_pos), bit-identical to
+    :func:`dropout_keep_mask`'s (q, k) element."""
+    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
     seed = seed_ref[0].astype(jnp.uint32)
     return _keep_from_counters(seed, jnp.uint32(lane), q_pos, k_pos,
                                rate)
@@ -172,43 +185,127 @@ def attention_reference(q, k, v, *, causal: bool = False,
 # --------------------------------------------------------------------- #
 # forward kernel
 # --------------------------------------------------------------------- #
+# The softmax runs in the log2 domain: scores are computed as
+# s2 = (q·scale·log2(e))@kᵀ (+ bias·log2(e)) and probabilities as
+# exp2(s2 - m2) — ``exp2`` measured 2.2x cheaper than ``exp`` on the
+# VPU (tools/mxu_probe.py) and the probabilities are bit-identical up
+# to fp rounding.  The saved logsumexp residual is likewise log2-domain
+# (lse2 = m2 + log2(l)); it never leaves the fwd/bwd kernel pair.
+_LOG2E = 1.4426950408889634
+
+
 def _scores(q_ref, k_ref, kvb_ref, i, j, *, scale, causal, per_q, bq,
             bk, sq, sk):
-    """Scaled scores for one (q-block, kv-block) tile: qkᵀ·scale
-    (+ bias) with causal positions pushed to -inf.
+    """log2-domain scaled scores for one (q-block, kv-block) tile,
+    TRANSPOSED — (bk, bq): kv positions on sublanes, q positions on
+    lanes — computed as k(q·scale·log2e)ᵀ (+ biasᵀ·log2e) with causal
+    positions at -inf.
 
-    ``per_q``: the bias block is (1, bq, bk) (per-query rows, e.g.
-    relative-position bias) instead of the (1, 1, bk) per-key row."""
+    The transposed orientation is the load-bearing layout decision
+    (measured, tools/mxu_probe.py): per-q softmax statistics become
+    native (1, bq) lane rows — so the saved (bh, 1, s) lse/delta blocks
+    broadcast into the tile with NO per-step sublane↔lane relayout —
+    and every downstream accumulation (O, dQ, dK, dV) contracts over
+    the tile's big dim with the head dim as M, the dot_general forms
+    that run the MXU at ~190 TFLOP/s vs ~86 for the (·, d)-output
+    forms whose N=64 pads half the array.  The score matmul itself
+    contracts d (irreducibly half-padded at d=64, ~89 TFLOP/s) in both
+    orientations.  The scale rides the small (bq, d) q tile (a ~0.06 µs
+    VPU pass) instead of the score tile (a ~1 µs pass at 1024² tiles).
+    ``per_q``: the bias block is (1, bk, bq) (per-query columns, from
+    the wrapper's pre-transposed bias) instead of (1, bk, 1) per-key.
+    """
     # operands stay in their input dtype (bf16 runs the MXU at full
     # rate; an fp32 upcast here would cost ~6-8x matmul throughput —
     # the reference's fused MHA likewise runs half-precision tensor-op
     # matmuls with fp32 softmax); accumulation is always fp32
+    qs = q_ref[0] * jnp.asarray(scale * _LOG2E, q_ref.dtype)
     s = jax.lax.dot_general(
-        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # (bq, bk) f32
+        k_ref[0], qs, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (bk, bq) f32
     if kvb_ref is not None:
+        # the bias arrives pre-multiplied by log2e (folded into
+        # _normalize_bias's one-time f32 copy, not a per-tile pass)
         if per_q:
-            s = s + kvb_ref[0]                     # (bq, bk) tile
+            s = s + kvb_ref[0]                     # (bk, bq) tile
         else:
-            s = s + kvb_ref[0, 0][None, :]         # (1, 1, bk) kv bias
+            s = s + kvb_ref[0, :, 0:1]             # (bk, 1) kv bias
     if causal:
-        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
         s = jnp.where(k_pos > q_pos + (sk - sq), _NEG_INF, s)
     return s
 
 
-def _zero_dead(s, p, causal, has_bias):
+# --------------------------------------------------------------------- #
+# triangular (causal) grid enumeration
+# --------------------------------------------------------------------- #
+# For causal self-attention (sq == sk, bq == bk) the live (i, j) tiles
+# form the lower triangle j <= i.  Instead of a rectangular grid with a
+# ``pl.when(block_live)`` skip — whose predicated body measured
+# ~+0.5 µs per 1024² tile on top of visiting twice the tiles — the
+# kernels enumerate ONLY the live tiles on one linear grid axis and
+# recover (i, j) from the step index with closed-form integer math
+# (f32 sqrt + one-step correction; exact for any practical block
+# count).  The same formulas run in the BlockSpec index maps (scalar
+# core) and the kernel body.
+
+def _tri_ij(t):
+    """Lower-triangle enumeration, j inner: t -> (i, j), j <= i."""
+    tf = 8.0 * t.astype(jnp.float32) + 1.0
+    i = ((jnp.sqrt(tf) - 1.0) * 0.5).astype(jnp.int32)
+    i = jnp.where(i * (i + 1) // 2 > t, i - 1, i)
+    i = jnp.where((i + 1) * (i + 2) // 2 <= t, i + 1, i)
+    j = t - i * (i + 1) // 2
+    return i, j
+
+
+def _tri_ji(t, nb):
+    """Upper-wedge enumeration, i inner: t -> (i, j), i >= j.
+
+    Row j holds ``nb - j`` tiles (i = j..nb-1), offset
+    ``off(j) = j·nb - j(j-1)/2``."""
+    a = 2 * nb + 1
+    tf = (a * a - 8 * t).astype(jnp.float32)
+    j = ((a - jnp.sqrt(tf)) * 0.5).astype(jnp.int32)
+
+    def off(x):
+        return x * nb - x * (x - 1) // 2
+
+    j = jnp.where(off(j) > t, j - 1, j)
+    j = jnp.where(off(j + 1) <= t, j + 1, j)
+    i = j + (t - off(j))
+    return i, j
+
+
+def _dead_rows_possible(causal, has_bias, sq, sk) -> bool:
+    """Can a query row be FULLY masked (every key dead)?  Only then is
+    the explicit dead-position zeroing needed: a fully-dead row has
+    running max / lse == -inf, making ``exp2(s - m) == 1`` where it
+    must be 0.  When every row has at least one live key (plain causal
+    self-attention with sq <= sk, or no masking at all), the running
+    max is finite from each lane's first live tile on, so
+    ``exp2(-1e30 - m)`` underflows to EXACTLY zero on dead positions
+    and the zeroing is redundant — and it is the single most expensive
+    VPU element of the tile loop (+1.15 µs of 4.7 on a 1024² tile,
+    measured in the round-4 ablation), so skipping it statically is a
+    ~20% forward-kernel win on the causal-LM hot path."""
+    if has_bias:
+        return True       # padding masks can kill whole rows
+    return causal and sq > sk
+
+
+def _zero_dead(s, p, causal, has_bias, sq, sk):
     """Zero probabilities at dead positions (score below the -inf
-    sentinel).  Needed because a fully-dead row has max/lse == -inf and
-    exp(s - m) == 1 there; dead rows must output exactly zero."""
-    if causal or has_bias:
+    sentinel) — only when a fully-dead row is statically possible
+    (see :func:`_dead_rows_possible`)."""
+    if _dead_rows_possible(causal, has_bias, sq, sk):
         return jnp.where(s < 0.5 * _NEG_INF, 0.0, p)
     return p
 
 
 def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
-                   sk_blocks, sq, sk):
+                   sk_blocks, sq, sk, tri):
     n = 3
     q_ref, k_ref, v_ref = refs[:3]
     kvb_ref = refs[n] if has_bias else None
@@ -216,9 +313,16 @@ def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
     seed_ref = refs[n] if rate > 0.0 else None
     n += 1 if rate > 0.0 else 0
     o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[n:]
-    j = pl.program_id(2)
-    i = pl.program_id(1)
     lane = pl.program_id(0)
+    if tri:
+        # triangular grid: only live tiles are visited, no predicated
+        # body (the pl.when wrap alone measured ~+0.5 µs/tile)
+        i, j = _tri_ij(pl.program_id(1))
+        final_pred = j == i
+    else:
+        j = pl.program_id(2)
+        i = pl.program_id(1)
+        final_pred = j == sk_blocks - 1
 
     @pl.when(j == 0)
     def _init():
@@ -226,66 +330,95 @@ def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal block skip: kv block j is live iff its first key position
-    # <= last query position (+ rectangular offset)
-    q_last = (i + 1) * bq - 1 + (sk - sq)
-    block_live = jnp.logical_or(not causal, j * bk <= q_last)
-
-    @pl.when(block_live)
     def _step():
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
                     causal=causal, per_q=per_q, bq=bq, bk=bk, sq=sq,
-                    sk=sk)
-        m_prev = m_ref[:]                          # (bq, 1)
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = _zero_dead(s, jnp.exp(s - m_new), causal, has_bias)
-        alpha = jnp.exp(m_prev - m_new)            # (bq, 1)
+                    sk=sk)                         # (bk, bq)
+        m_prev = m_ref[:]                          # (1, bq) lane row
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+        p = _zero_dead(s, jnp.exp2(s - m_new), causal, has_bias,
+                       sq, sk)
+        alpha = jnp.exp2(m_prev - m_new)           # (1, bq)
         # the normalizer accumulates the UNDROPPED probabilities (the
         # softmax denominator is dropout-independent, torch semantics);
         # only the value accumulation sees the dropped/rescaled probs
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=0, keepdims=True)
         if rate > 0.0:
             keep = _dropout_keep_tile(seed_ref, lane, i, j, bq, bk,
                                       rate)
             p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
         # probs ride the MXU in the value dtype (fp32 softmax, half pv
-        # matmul — reference fused-MHA recipe), accumulate fp32
+        # matmul — reference fused-MHA recipe), accumulate fp32; the
+        # (d, bq) accumulator contracts over bk at full MXU rate and
+        # the (1, bq) alpha broadcasts with no relayout (see _scores)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            v_ref[0], p.astype(v_ref.dtype), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
-    @pl.when(j == sk_blocks - 1)
+    if tri:
+        _step()
+    else:
+        # causal block skip: kv block j is live iff its first key
+        # position <= last query position (+ rectangular offset)
+        q_last = (i + 1) * bq - 1 + (sk - sq)
+        block_live = jnp.logical_or(not causal, j * bk <= q_last)
+        pl.when(block_live)(_step)
+
+    @pl.when(final_pred)
     def _final():
-        l = l_ref[:]
+        l = l_ref[:]                               # (1, bq)
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+        # one amortized (d, bq) -> (bq, d) transpose per q block
+        o_ref[0] = jnp.transpose(acc_ref[:] / l_safe).astype(o_ref.dtype)
+        # lse saved in the log2 domain (consumed only by the backward);
+        # already a lane row — no relayout
+        lse_ref[0] = m_ref[:] + jnp.log2(l_safe)
 
 
-def _qkv_specs(d, bq, bk, rep):
-    """BlockSpecs for q/k/v under grid (b*h, i, j).  GQA: `rep`
-    consecutive q heads share one kv head — the kv BlockSpecs index
-    b // rep, so kv is never materialized per-q-head in HBM."""
+def _tri_maps(tri, swapped, nb):
+    """(i_map, j_map): block-index extractors for the grid's trailing
+    axes — rectangular (b, i, j) / (b, j, i), or triangular (b, t)
+    with (i, j) recovered from t (see :func:`_tri_ij`)."""
+    if tri and swapped:
+        return ((lambda t: _tri_ji(t, nb)[0]),
+                (lambda t: _tri_ji(t, nb)[1]))
+    if tri:
+        return (lambda t: _tri_ij(t)[0]), (lambda t: _tri_ij(t)[1])
+    if swapped:
+        return (lambda j, i: i), (lambda j, i: j)
+    return (lambda i, j: i), (lambda i, j: j)
+
+
+def _qkv_specs(d, bq, bk, rep, tri=False, swapped=False, nb=0):
+    """BlockSpecs for q/k/v under grid (b*h, i, j) (or the triangular
+    (b*h, t)).  GQA: `rep` consecutive q heads share one kv head — the
+    kv BlockSpecs index b // rep, so kv is never materialized
+    per-q-head in HBM."""
+    im, jm = _tri_maps(tri, swapped, nb)
     return [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+        pl.BlockSpec((1, bq, d), lambda b, *g: (b, im(*g), 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
+        pl.BlockSpec((1, bk, d), lambda b, *g: (b // rep, jm(*g), 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0),
+        pl.BlockSpec((1, bk, d), lambda b, *g: (b // rep, jm(*g), 0),
                      memory_space=pltpu.VMEM),
     ]
 
 
-def _bias_spec(mode, nh, bq, bk, *, swapped: bool = False):
-    """BlockSpec for the normalized (B0*H0, S0, sk) bias.
+def _bias_spec(mode, nh, bq, bk, *, swapped: bool = False, tri=False,
+               nb=0):
+    """BlockSpec for the normalized TRANSPOSED (B0*H0, sk, S0) bias
+    (key dim on sublanes, matching the kernels' (bk, bq) score tiles).
 
     ``mode = (has_batch, has_head, per_q)`` statics; the leading array
     index is ``batch*H0 + head`` with H0 == nh when has_head.  The
-    per-key form keeps a middle singleton so the block's last two dims
-    stay TPU-tileable.  ``swapped``: the dkv grid is (b, j, i)."""
+    per-key form keeps a trailing singleton so the (bk, 1) block
+    broadcasts over lanes natively.  ``swapped``: the dkv grid is
+    (b, j, i)."""
     has_batch, has_head, per_q = mode
     h0 = nh if has_head else 1
+    im, jm = _tri_maps(tri, swapped, nb)
 
     def lead(bb):
         batch = bb // nh if has_batch else 0
@@ -293,36 +426,40 @@ def _bias_spec(mode, nh, bq, bk, *, swapped: bool = False):
         return batch * h0 + head
 
     if per_q:
-        if swapped:
-            return pl.BlockSpec((1, bq, bk),
-                                lambda b, j, i: (lead(b), i, j),
-                                memory_space=pltpu.VMEM)
-        return pl.BlockSpec((1, bq, bk), lambda b, i, j: (lead(b), i, j),
+        return pl.BlockSpec((1, bk, bq),
+                            lambda b, *g: (lead(b), jm(*g), im(*g)),
                             memory_space=pltpu.VMEM)
-    if swapped:
-        return pl.BlockSpec((1, 1, bk), lambda b, j, i: (lead(b), 0, j),
-                            memory_space=pltpu.VMEM)
-    return pl.BlockSpec((1, 1, bk), lambda b, i, j: (lead(b), 0, j),
+    return pl.BlockSpec((1, bk, 1), lambda b, *g: (lead(b), jm(*g), 0),
                         memory_space=pltpu.VMEM)
 
 
 _SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
+def _use_tri(causal, sq, sk, bq, bk) -> bool:
+    """Triangular-grid eligibility: causal self-attention with equal
+    seq lengths and square blocks (the LM hot path)."""
+    return bool(causal) and sq == sk and bq == bk
+
+
 def _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode, rate,
                 rep, nh, bq, bk, interpret):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
-    grid = (bh, sq // bq, sk // bk)
+    tri = _use_tri(causal, sq, sk, bq, bk)
+    nb = sq // bq
+    grid = (bh, nb * (nb + 1) // 2) if tri else (bh, nb, sk // bk)
+    im, jm = _tri_maps(tri, False, nb)
     has_bias = kvb is not None
     kernel = functools.partial(
         _fa_fwd_kernel, scale=scale, causal=causal, has_bias=has_bias,
         per_q=bool(bias_mode and bias_mode[2]), rate=rate,
-        bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq, sk=sk)
-    in_specs = _qkv_specs(d, bq, bk, rep)
+        bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq, sk=sk, tri=tri)
+    in_specs = _qkv_specs(d, bq, bk, rep, tri=tri, nb=nb)
     args = [q3, k3, v3]
     if has_bias:
-        in_specs.append(_bias_spec(bias_mode, nh, bq, bk))
+        in_specs.append(_bias_spec(bias_mode, nh, bq, bk, tri=tri,
+                                   nb=nb))
         args.append(kvb)
     if rate > 0.0:
         in_specs.append(_SEED_SPEC)
@@ -332,9 +469,9 @@ def _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode, rate,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+            pl.BlockSpec((1, bq, d), lambda b, *g: (b, im(*g), 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i),
+            pl.BlockSpec((1, 1, bq), lambda b, *g: (b, 0, im(*g)),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
@@ -343,9 +480,9 @@ def _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode, rate,
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((d, bq), jnp.float32),      # transposed acc
+            pltpu.VMEM((1, bq), jnp.float32),      # m (lane row)
+            pltpu.VMEM((1, bq), jnp.float32),      # l (lane row)
         ],
         interpret=interpret,
     )(*args)
@@ -357,83 +494,103 @@ def _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode, rate,
 # --------------------------------------------------------------------- #
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
                       *refs, scale, causal, has_bias, per_q, rate, bq,
-                      bk, sk_blocks, sq, sk):
+                      bk, sk_blocks, sq, sk, tri):
     n = 0
     kvb_ref = refs[n] if has_bias else None
     n += 1 if has_bias else 0
     seed_ref = refs[n] if rate > 0.0 else None
     n += 1 if rate > 0.0 else 0
     do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs[n:]
-    j = pl.program_id(2)
-    i = pl.program_id(1)
     lane = pl.program_id(0)
+    if tri:
+        i, j = _tri_ij(pl.program_id(1))
+        final_pred = j == i
+    else:
+        j = pl.program_id(2)
+        i = pl.program_id(1)
+        final_pred = j == sk_blocks - 1
 
     @pl.when(j == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q_last = (i + 1) * bq - 1 + (sk - sq)
-    block_live = jnp.logical_or(not causal, j * bk <= q_last)
-
-    @pl.when(block_live)
     def _step():
-        lse = lse_ref[0, 0][:, None]               # (bq, 1)
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0]                           # (1, bq), log2 dom
+        delta = delta_ref[0]                       # (1, bq)
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
                     causal=causal, per_q=per_q, bq=bq, bk=bk, sq=sq,
-                    sk=sk)
-        # dead rows have lse == -inf making exp(s - lse) == 1 there;
+                    sk=sk)                         # (bk, bq)
+        # dead rows have lse == -inf making exp2(s - lse) == 1 there;
         # _zero_dead restores exact zeros
-        p = _zero_dead(s, jnp.exp(s - lse), causal, has_bias)
-        # half-dtype operands, fp32 accumulation (see _scores)
+        p = _zero_dead(s, jnp.exp2(s - lse), causal, has_bias,
+                       sq, sk)
+        # dPᵀ = V dOᵀ — half-dtype operands, fp32 accumulation; the
+        # d contraction is the irreducibly-padded one (see _scores)
         dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)    # (bq, bk)
+            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bk, bq)
         if rate > 0.0:
             # dS = P ∘ (D∘dP - delta): same mask as the forward tile;
             # delta = rowsum(dO·O) already contains the dropout factor
             keep = _dropout_keep_tile(seed_ref, lane, i, j, bq, bk,
                                       rate)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
-        ds = p * (dp - delta) * scale
+        # the softmax scale is deferred to the final write (dq is
+        # linear in it); dsᵀ here is pᵀ·(dpᵀ - delta)
+        ds = p * (dp - delta)                      # (bk, bq)
+        # (d, bq) accumulator: dqᵀ += kᵀ dS — contracts over bk at
+        # full MXU rate (tools/mxu_probe.py)
         acc_ref[:] += jax.lax.dot_general(
-            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            k_ref[0], ds.astype(k_ref.dtype), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(j == sk_blocks - 1)
+    if tri:
+        _step()
+    else:
+        q_last = (i + 1) * bq - 1 + (sk - sq)
+        block_live = jnp.logical_or(not causal, j * bk <= q_last)
+        pl.when(block_live)(_step)
+
+    @pl.when(final_pred)
     def _final():
-        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+        # one amortized (d, bq) -> (bq, d) transpose per q block
+        dq_ref[0] = jnp.transpose(
+            acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
                        *refs, scale, causal, has_bias, per_q, rate, bq,
-                       bk, sq_blocks, sq, sk):
+                       bk, sq_blocks, sq, sk, tri):
     n = 0
     kvb_ref = refs[n] if has_bias else None
     n += 1 if has_bias else 0
     seed_ref = refs[n] if rate > 0.0 else None
     n += 1 if rate > 0.0 else 0
     do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs[n:]
-    i = pl.program_id(2)      # q block (sequential axis)
-    j = pl.program_id(1)      # kv block
     lane = pl.program_id(0)
+    if tri:
+        # upper-wedge enumeration: kv block j outer, q block i inner
+        # from the diagonal down (i = j..nb-1)
+        i, j = _tri_ji(pl.program_id(1), sq_blocks)
+        init_pred = i == j
+    else:
+        i = pl.program_id(2)      # q block (sequential axis)
+        j = pl.program_id(1)      # kv block
+        init_pred = i == 0
 
-    @pl.when(i == 0)
+    @pl.when(init_pred)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q_last = (i + 1) * bq - 1 + (sk - sq)
-    block_live = jnp.logical_or(not causal, j * bk <= q_last)
-
-    @pl.when(block_live)
     def _step():
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0]                           # (1, bq), log2 dom
+        delta = delta_ref[0]                       # (1, bq)
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
                     causal=causal, per_q=per_q, bq=bq, bk=bk, sq=sq,
-                    sk=sk)
-        p = _zero_dead(s, jnp.exp(s - lse), causal, has_bias)
+                    sk=sk)                         # (bk, bq)
+        p = _zero_dead(s, jnp.exp2(s - lse), causal, has_bias,
+                       sq, sk)
         if rate > 0.0:
             keep = _dropout_keep_tile(seed_ref, lane, i, j, bq, bk,
                                       rate)
@@ -441,25 +598,42 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
             pd = jnp.where(keep, p * inv, 0.0)     # dropped probs
         else:
             keep, pd = None, p
-        # dv += (P∘D)ᵀ @ do — half-dtype operands, fp32 accumulation
+        # TRANSPOSED accumulators (d, bk): contracting over bq with the
+        # head dim as M runs the MXU at full rate (194 vs 86 TFLOP/s,
+        # tools/mxu_probe.py); one (d, bk) -> (bk, d) transpose per kv
+        # block at the end (amortized over the inner q sweep).
+        # dvᵀ += dOᵀ (P∘D)ᵀ — half-dtype operands, fp32 accumulation
         dv_acc[:] += jax.lax.dot_general(
-            pd.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            do_ref[0], pd.astype(do_ref.dtype), (((0,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        # dPᵀ = V dOᵀ (d contraction, irreducibly padded)
         dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bk, bq)
         if rate > 0.0:
             dp = jnp.where(keep, dp * inv, 0.0)
-        ds = p * (dp - delta) * scale              # (bq, bk) f32
-        # dk += dsᵀ @ q
+        # dkᵀ += (q·scale·log2e)ᵀᵀ dSᵀᵀ with the log2e divided back out
+        # at the final write — reuses the score recompute's scaled q
+        # tile (CSE'd) and keeps the softmax scale off the score-sized
+        # (bk, bq) pass entirely
+        ds = p * (dp - delta)                      # (bk, bq) f32
+        qs = q_ref[0] * jnp.asarray(scale * _LOG2E, q_ref.dtype)
         dk_acc[:] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            qs, ds.astype(q_ref.dtype), (((0,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    if tri:
+        _step()
+    else:
+        q_last = (i + 1) * bq - 1 + (sk - sq)
+        block_live = jnp.logical_or(not causal, j * bk <= q_last)
+        pl.when(block_live)(_step)
 
     @pl.when(i == sq_blocks - 1)
     def _final():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[0] = jnp.transpose(
+            dk_acc[:] * (1.0 / _LOG2E)).astype(dk_ref.dtype)
+        dv_ref[0] = jnp.transpose(dv_acc[:]).astype(dv_ref.dtype)
 
 
 def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
@@ -471,77 +645,84 @@ def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]           # (bh, 1, sq)
 
+    tri = _use_tri(causal, sq, sk, bq, bk)
+    nb = sq // bq
+    im, jm = _tri_maps(tri, False, nb)
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, scale=scale, causal=causal, has_bias=has_bias,
         per_q=per_q, rate=rate, bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq,
-        sk=sk)
-    in_specs = _qkv_specs(d, bq, bk, rep)
+        sk=sk, tri=tri)
+    in_specs = _qkv_specs(d, bq, bk, rep, tri=tri, nb=nb)
     args = [q3, k3, v3]
     if has_bias:
-        in_specs.append(_bias_spec(bias_mode, nh, bq, bk))
+        in_specs.append(_bias_spec(bias_mode, nh, bq, bk, tri=tri,
+                                   nb=nb))
         args.append(kvb)
     if rate > 0.0:
         in_specs.append(_SEED_SPEC)
         args.append(seed)
     in_specs += [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+        pl.BlockSpec((1, bq, d), lambda b, *g: (b, im(*g), 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i),
+        pl.BlockSpec((1, 1, bq), lambda b, *g: (b, 0, im(*g)),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i),
+        pl.BlockSpec((1, 1, bq), lambda b, *g: (b, 0, im(*g)),
                      memory_space=pltpu.VMEM),
     ]
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh, sq // bq, sk // bk),
+        grid=(bh, nb * (nb + 1) // 2) if tri else (bh, nb, sk // bk),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, *g: (b, im(*g), 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((d, bq), jnp.float32)],
         interpret=interpret,
     )(*args, do3, lse, delta)
 
     dkv_kernel = functools.partial(
         _fa_bwd_dkv_kernel, scale=scale, causal=causal,
         has_bias=has_bias, per_q=per_q, rate=rate, bq=bq, bk=bk,
-        sq_blocks=sq // bq, sq=sq, sk=sk)
+        sq_blocks=sq // bq, sq=sq, sk=sk, tri=tri)
     # dk/dv are computed per *q* head (grid axis 0 = b*h) so each output
     # block is owned by one grid lane; for GQA the rep-sized head groups
     # are summed afterwards (cheap, fp32) instead of making the kernel
-    # revisit shared kv output blocks.  NB grid order (b, j, i): the
-    # index maps below permute accordingly.
+    # revisit shared kv output blocks.  NB grid order (b, j, i) — or
+    # the triangular (b, t) upper-wedge enumeration: the index maps
+    # permute accordingly.
+    im2, jm2 = _tri_maps(tri, True, nb)
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+        pl.BlockSpec((1, bq, d), lambda b, *g: (b, im2(*g), 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b // rep, j, 0),
+        pl.BlockSpec((1, bk, d), lambda b, *g: (b // rep, jm2(*g), 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b // rep, j, 0),
+        pl.BlockSpec((1, bk, d), lambda b, *g: (b // rep, jm2(*g), 0),
                      memory_space=pltpu.VMEM),
     ]
     args = [q3, k3, v3]
     if has_bias:
-        in_specs.append(_bias_spec(bias_mode, nh, bq, bk, swapped=True))
+        in_specs.append(_bias_spec(bias_mode, nh, bq, bk, swapped=True,
+                                   tri=tri, nb=nb))
         args.append(kvb)
     if rate > 0.0:
         in_specs.append(_SEED_SPEC)
         args.append(seed)
     in_specs += [
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+        pl.BlockSpec((1, bq, d), lambda b, *g: (b, im2(*g), 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i),
+        pl.BlockSpec((1, 1, bq), lambda b, *g: (b, 0, im2(*g)),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i),
+        pl.BlockSpec((1, 1, bq), lambda b, *g: (b, 0, im2(*g)),
                      memory_space=pltpu.VMEM),
     ]
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, sk // bk, sq // bq),
+        grid=(bh, nb * (nb + 1) // 2) if tri else (bh, sk // bk, nb),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+            pl.BlockSpec((1, bk, d), lambda b, *g: (b, jm2(*g), 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+            pl.BlockSpec((1, bk, d), lambda b, *g: (b, jm2(*g), 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
@@ -553,8 +734,8 @@ def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
                 (bh, sk, d), jnp.float32 if rep > 1 else v3.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bk, d), jnp.float32),
-            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((d, bk), jnp.float32),      # transposed dk acc
+            pltpu.VMEM((d, bk), jnp.float32),      # transposed dv acc
         ],
         interpret=interpret,
     )(*args, do3, lse, delta)
@@ -619,9 +800,12 @@ def _pick_block(s: int, want: int) -> int:
 
 def _normalize_bias(bias, b, h, sq, sk):
     """Normalize a broadcastable 4-d additive bias to the kernels'
-    (B0*H0, S0, sk) layout + static ``(has_batch, has_head, per_q)``
-    mode.  Returns (None, None) when the bias can't ride the kernel
-    (wrong rank, unbroadcastable dims, or a sub-sk key dim)."""
+    TRANSPOSED (B0*H0, sk, S0) layout (key dim on sublanes, matching
+    the (bk, bq) score tiles) + static ``(has_batch, has_head, per_q)``
+    mode.  The transpose is free for the common per-key masks (S0 == 1)
+    and one XLA pass for full per-query score biases.  Returns
+    (None, None) when the bias can't ride the kernel (wrong rank,
+    unbroadcastable dims, or a sub-sk key dim)."""
     if bias is None or bias.ndim != 4:
         return None, None
     b0, h0, s0, k0 = bias.shape
@@ -629,7 +813,11 @@ def _normalize_bias(bias, b, h, sq, sk):
             or s0 not in (1, sq)):
         return None, None
     mode = (b0 == b, h0 == h, s0 == sq)
-    bias3 = bias.reshape(b0 * h0, s0, sk).astype(jnp.float32)
+    # fold the log2-domain conversion into this one-time copy so the
+    # kernels never spend a per-tile pass on it; the -1e30 mask
+    # sentinel stays below the dead-position threshold either way
+    bias3 = (bias.reshape(b0 * h0, s0, sk).swapaxes(1, 2)
+             .astype(jnp.float32) * _LOG2E)
     return bias3, mode
 
 
