@@ -198,11 +198,10 @@ class ParallelAttention(nn.Module):
             dropout_rng=self.make_rng("dropout") if drop > 0.0 else None,
             block_q=cfg.attention_block_q,
             block_k=cfg.attention_block_k)
-        # named so remat_policy="save_only:attn_out" can keep the flash
-        # output (cheap: b·s·h bf16) and skip recomputing the whole
-        # attention in backward
-        from jax.ad_checkpoint import checkpoint_name
-        o = checkpoint_name(o, "attn_out")
+        # remat_policy="save_only:attn_out,attn_lse" saves the flash
+        # kernel's own output/lse residuals — named inside the kernel's
+        # fwd rule (ops/attention.py), not here: a second layer-level
+        # tag with the same name would store the attention output twice
         o = o.reshape(b, s, h * d)
         return RowParallelLinear(
             features=cfg.hidden_size, use_bias=True,
