@@ -31,19 +31,25 @@ _VALID = ("auto", "pallas", "pallas_interpret", "xla")
 
 
 def resolve_impl(implementation: Optional[str], *,
-                 pallas_ok: bool = True) -> str:
+                 pallas_ok: bool = True,
+                 auto_default: str = "pallas") -> str:
     """Resolve an ``implementation`` argument to a concrete choice.
 
     ``pallas_ok=False`` signals the caller's shapes are outside the
     kernel's support envelope (e.g. unaligned hidden size) — "auto"
-    then resolves to "xla".
+    then resolves to "xla".  ``auto_default`` is the op's own
+    TPU preference for "auto" — ops whose XLA composition measured
+    FASTER than their kernel (group_norm, BASELINE.md round 4) pass
+    ``"xla"`` so the measured winner is the default while explicit
+    ``implementation=``/env overrides still reach the kernel.
     """
     impl = implementation or os.environ.get("APEX_TPU_OPS_IMPL", "auto")
     if impl not in _VALID:
         raise ValueError(
             f"implementation={impl!r} not in {_VALID}")
     if impl == "auto":
-        if pallas_ok and jax.default_backend() == "tpu":
+        if (auto_default == "pallas" and pallas_ok
+                and jax.default_backend() == "tpu"):
             return "pallas"
         return "xla"
     return impl

@@ -4,11 +4,18 @@ Reference: ``apex/contrib/group_norm`` and ``group_norm_v2`` (+
 ``apex/contrib/csrc/group_norm*``) — NHWC GroupNorm with fused SiLU
 ("swish") epilogue, built for diffusion UNets.
 
-TPU design.  Round 2 shipped this as an XLA composition on the
-rationale that a bandwidth-bound op can't beat the compiler; the
-round-3 measurement refuted that (70 GB/s ≈ 9% of peak HBM on a
-diffusion-typical (8, 64², 512) fwd+bwd — BASELINE.md), so GroupNorm
-gets real kernels like the reference:
+TPU design — and an honest measurement story.  Round 2 shipped this
+as an XLA composition ("a bandwidth-bound op can't beat the
+compiler"); round 3 measured the composition at "9% of peak HBM" and
+wrote these Pallas kernels in response; round 4 found BOTH round-3
+numbers were ~80% fixed tunnel-call overhead (~100 ms per call over
+50 steps) and re-measured cleanly: the composition runs at **85% of
+peak HBM** (238 µs fwd+bwd at (8, 64², 512)+SiLU) and beats these
+kernels (542 µs) by 2.3× — round 2 was right all along
+(BASELINE.md round-4 GN section).  The composition is therefore the
+default on every backend; the kernels below stay available
+(``implementation="pallas"``), golden-tested, as a documented
+negative result and the reference-parity NHWC kernel structure:
 
 - **fwd**: one ``pallas_call``, grid ``(N, 2, R/br)`` over spatial row
   blocks with a two-phase sweep per sample — phase 0 accumulates
@@ -362,7 +369,17 @@ def group_norm(x, num_groups: int, weight=None, bias=None, *,
     # the data blocks — 1024² f32 = 4 MB is safe; 2048² (16.7 MB)
     # is not.  Larger channels take the XLA path.
     pallas_ok = (c % 128 == 0 and c <= 1024 and br is not None)
-    impl = resolve_impl(implementation, pallas_ok=pallas_ok)
+    # DEFAULT = the XLA composition, on TPU too: the round-4
+    # overhead-corrected A/B measured the composition 2.3x FASTER than
+    # the Pallas kernels on the diffusion-typical fwd+bwd (238 vs
+    # 542 µs at (8, 64², 512)+SiLU — BASELINE.md round-4 GN section;
+    # round 3's opposite conclusion divided ~100 ms of fixed tunnel
+    # overhead over 50 steps).  XLA fuses the normalize/activation
+    # into single sweeps the hand-written two-phase kernel cannot
+    # match.  The kernels remain under implementation="pallas" (and
+    # the APEX_TPU_OPS_IMPL env override is still honored).
+    impl = resolve_impl(implementation, pallas_ok=pallas_ok,
+                        auto_default="xla")
     if impl == "xla":
         return group_norm_reference(x, num_groups, weight, bias,
                                     eps=eps, act=act)

@@ -35,22 +35,46 @@ element vs 4 (or 2 with ``moment_dtype=bf16``).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
 import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._dispatch import resolve_impl
 
 __all__ = ["fused_adam", "FusedAdamState"]
 
 _FP8 = jnp.float8_e4m3fn
 _FP8_MAX = 448.0          # e4m3 finite max
 _FP8_BLOCK = 256
+# rows of 256 per grid step for the fused fp8 kernel (~1.5 MB of f32
+# working tiles in VMEM); leaves below _FP8_KERNEL_MIN elements use
+# the XLA path (and pad only to the quant block, not the row chunk)
+_FP8_KERNEL_ROWS = 512
+_FP8_KERNEL_MIN = _FP8_BLOCK * 64
+
+
+def _fp8_pad(n):
+    """Quantized-state length for ``n`` elements.  Kernel-path leaves
+    (n >= _FP8_KERNEL_MIN) pad to a whole number of kernel row-chunks
+    so the fused kernel's grid is exact (no ragged tail; waste
+    ≤ 128 KiB of fp8 on leaves ≥ 16 Ki elements); smaller leaves stay
+    on the XLA path and pad only to the 256-element quant block —
+    chunk-padding them would turn a 1 Ki-element bias's moments into
+    ~256 KiB of dead state."""
+    n = max(1, n)
+    if n < _FP8_KERNEL_MIN:
+        return -(-n // _FP8_BLOCK) * _FP8_BLOCK
+    chunk = _FP8_BLOCK * _FP8_KERNEL_ROWS
+    return -(-n // chunk) * chunk
 
 
 def _fp8_zeros(p):
-    n = max(1, p.size)
-    npad = -(-n // _FP8_BLOCK) * _FP8_BLOCK
+    npad = _fp8_pad(p.size)
     return {"q": jnp.zeros((npad,), _FP8),
             "scale": jnp.zeros((npad // _FP8_BLOCK,), jnp.float32)}
 
@@ -62,12 +86,118 @@ def _fp8_dequant(st, n):
 
 def _fp8_quant(x_flat):
     n = x_flat.shape[0]
-    npad = -(-max(1, n) // _FP8_BLOCK) * _FP8_BLOCK
+    npad = _fp8_pad(n)
     xb = jnp.pad(x_flat, (0, npad - n)).reshape(-1, _FP8_BLOCK)
     absmax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
     scale = jnp.maximum(absmax / _FP8_MAX, 1e-30)
     return {"q": (xb / scale).astype(_FP8).reshape(-1),
             "scale": scale[:, 0]}
+
+
+# --------------------------------------------------------------------- #
+# fused fp8-moment Adam kernel — ONE pass over grads/moments: dequant a
+# moment block, update, requant, emit the param update.  This is the
+# fix for BASELINE.md's round-3 measured negative: the XLA-composed
+# quant/dequant materialized each moment as a full fp32 array between
+# separate passes (165.6 GB accessed vs 99.5 dense), erasing the 1-byte
+# storage win; in-kernel the fp32 moment exists only as a VMEM tile.
+# Traffic per element with weight_decay=0: read g(4B) + m,v(1B each +
+# scales) and write m,v(1B each) + upd(4B) ≈ 12 B vs 24 B for the dense
+# fp32-moment update.  Measured caveat (BASELINE.md round-4 fp8
+# section): this chip streams 1-byte blocks at ~1/9 of peak HBM
+# bandwidth, so the 2x traffic model does NOT become a 2x time win —
+# fp8 moments are a 4x state-MEMORY option (~8% step-time cost on the
+# BERT step), not a throughput one.
+# --------------------------------------------------------------------- #
+def _fp8_adam_kernel(sc_ref, *refs, b1, b2, eps, wd, adamw, has_p, br):
+    n = 0
+    g_ref = refs[n]; n += 1
+    p_ref = refs[n] if has_p else None
+    n += 1 if has_p else 0
+    mq_ref, ms_ref, vq_ref, vs_ref = refs[n:n + 4]
+    upd_ref, mq2_ref, ms2_ref, vq2_ref, vs2_ref = refs[n + 4:]
+    lr, bc1, bc2 = sc_ref[0], sc_ref[1], sc_ref[2]
+    # scale arrays are WHOLE-resident in VMEM as (chunks, br) — tiny
+    # (4 bytes per 1 KiB of moments) and lane-dense; per-step
+    # (rows, 1) column-block DMAs measured ~0.75 µs each, ~35% of the
+    # kernel's whole runtime at 4 per step.  The (br,)-row -> (br, 1)
+    # column relayout here is VMEM-local and far cheaper.
+    i = pl.program_id(0)
+    ms = jnp.transpose(ms_ref[pl.ds(i, 1), :])     # (br, 1)
+    vs = jnp.transpose(vs_ref[pl.ds(i, 1), :])
+    g = g_ref[:].astype(jnp.float32)
+    m = mq_ref[:].astype(jnp.float32) * ms
+    v = vq_ref[:].astype(jnp.float32) * vs
+    if has_p and not adamw:
+        g = g + wd * p_ref[:].astype(jnp.float32)
+    m2 = b1 * m + (1.0 - b1) * g
+    v2 = b2 * v + (1.0 - b2) * (g * g)
+    denom = jnp.sqrt(v2 / bc2) + eps
+    step = m2 / (bc1 * denom)
+    if has_p and adamw:
+        step = step + wd * p_ref[:].astype(jnp.float32)
+    upd_ref[:] = (-lr * step).astype(upd_ref.dtype)
+    for x2, q_ref, s_ref in ((m2, mq2_ref, ms2_ref),
+                             (v2, vq2_ref, vs2_ref)):
+        absmax = jnp.max(jnp.abs(x2), axis=1, keepdims=True)
+        sc = jnp.maximum(absmax / _FP8_MAX, 1e-30)
+        q_ref[:] = (x2 / sc).astype(_FP8)
+        s_ref[pl.ds(i, 1), :] = jnp.transpose(sc)
+
+
+def _fp8_adam_leaf_pallas(g, p, m, v, lr_bc, b1, b2, eps, wd, adamw,
+                          interpret):
+    """Run the fused kernel over one flattened leaf.  Returns
+    (update, m_state, v_state) with the same {"q","scale"} layout."""
+    n = p.size
+    rows = m["q"].shape[0] // _FP8_BLOCK
+    npad = rows * _FP8_BLOCK
+
+    def to_rows(x):
+        flat = x.astype(jnp.float32).reshape(-1)
+        if npad != n:                       # free reshape when aligned
+            flat = jnp.pad(flat, (0, npad - n))
+        return flat.reshape(rows, _FP8_BLOCK)
+
+    has_p = wd != 0.0
+    br = min(_FP8_KERNEL_ROWS, rows)
+    assert rows % br == 0, (rows, br)      # _fp8_pad guarantees this
+    chunks = rows // br
+    args = [to_rows(g)]
+    if has_p:
+        args.append(to_rows(p))
+    args += [m["q"].reshape(rows, _FP8_BLOCK),
+             m["scale"].reshape(chunks, br),
+             v["q"].reshape(rows, _FP8_BLOCK),
+             v["scale"].reshape(chunks, br)]
+    grid = (chunks,)
+    row_spec = pl.BlockSpec((br, _FP8_BLOCK), lambda r: (r, 0),
+                            memory_space=pltpu.VMEM)
+    sc_spec = pl.BlockSpec(memory_space=pltpu.VMEM)  # whole-resident
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    in_specs += [row_spec] * (2 if has_p else 1)
+    in_specs += [row_spec, sc_spec, row_spec, sc_spec]
+    kernel = functools.partial(
+        _fp8_adam_kernel, b1=b1, b2=b2, eps=eps, wd=wd, adamw=adamw,
+        has_p=has_p, br=br)
+    upd2, mq2, ms2, vq2, vs2 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[row_spec, row_spec, sc_spec, row_spec, sc_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, _FP8_BLOCK), jnp.float32),
+            jax.ShapeDtypeStruct((rows, _FP8_BLOCK), _FP8),
+            jax.ShapeDtypeStruct((chunks, br), jnp.float32),
+            jax.ShapeDtypeStruct((rows, _FP8_BLOCK), _FP8),
+            jax.ShapeDtypeStruct((chunks, br), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lr_bc, *args)
+    upd = upd2.reshape(-1)[:n].reshape(p.shape).astype(p.dtype)
+    return (upd,
+            {"q": mq2.reshape(-1), "scale": ms2.reshape(-1)},
+            {"q": vq2.reshape(-1), "scale": vs2.reshape(-1)})
 
 
 class FusedAdamState(NamedTuple):
@@ -137,9 +267,21 @@ def fused_adam(
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
+        impl = resolve_impl(None)
+
         def leaf(g, p, m, v):
             if fp8:
                 n = p.size
+                if impl != "xla" and n >= _FP8_KERNEL_MIN:
+                    # fused Pallas path: dequant-update-requant in one
+                    # pass over the moments (see _fp8_adam_kernel)
+                    lr_bc = jnp.stack([
+                        jnp.asarray(lr, jnp.float32),
+                        bc1.astype(jnp.float32),
+                        bc2.astype(jnp.float32)])
+                    return _fp8_adam_leaf_pallas(
+                        g, p, m, v, lr_bc, b1, b2, eps, weight_decay,
+                        adam_w_mode, impl == "pallas_interpret")
                 m_f = _fp8_dequant(m, n)
                 v_f = _fp8_dequant(v, n)
                 gf = g.astype(jnp.float32).reshape(-1)

@@ -36,7 +36,8 @@ def _emit(d):
     print(json.dumps(d))
 
 
-def _measure(state, step, batch, samples_per_step, extra=None):
+def _measure(state, step, batch, samples_per_step, extra=None,
+             measured_tflops=None):
     n_steps = int(os.environ.get("BENCH_STEPS", "20"))
     k_windows = max(1, int(os.environ.get("BENCH_WINDOWS", "3")))
     # AOT-compile: the executable doubles as the memory/cost analysis
@@ -54,7 +55,8 @@ def _measure(state, step, batch, samples_per_step, extra=None):
         "loss_finite": finite,
     }
     out.update(bench._memory_fields(compiled))
-    out.update(bench._roofline_fields(compiled, dt))
+    out.update(bench._roofline_fields(compiled, dt,
+                                      measured_tflops=measured_tflops))
     out.update(extra or {})
     return out
 
@@ -310,6 +312,7 @@ def bench_gpt2_tp8_full_step():
         if hasattr(x, "size"))
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1))
+    ln_v = float(np.log(cfg.vocab_size))
     with jax.set_mesh(mesh):
         jitted = jax.jit(
             train_step,
@@ -330,12 +333,18 @@ def bench_gpt2_tp8_full_step():
         loss = float(loss)
         dt = time.perf_counter() - t0
     assert np.isfinite(loss), f"non-finite loss {loss}"
+    # init-loss plausibility (round-3 verdict item 4): a correctly
+    # wired fresh model scores ≈ uniform over the vocab
+    assert 0.8 * ln_v <= loss <= 1.6 * ln_v, (
+        f"init loss {loss} implausible vs ln(V)={ln_v:.3f}")
     _emit({
         "metric": "gpt2_1p3b_tp8_sp_train_step_executed",
         "value": 1,
         "unit": "ok",
         "executed": True,
         "loss": round(loss, 4),
+        "loss_over_ln_vocab": round(loss / ln_v, 3),
+        "loss_plausibility_checked": "0.8 <= loss/ln(V) <= 1.6",
         "grads_finite": bool(finite),
         "batch": b, "seq": s,
         "host_cpu_step_seconds": round(dt, 1),
@@ -424,8 +433,17 @@ def bench_gpt2_3d_full_step():
         head = jnp.asarray(
             rng.normal(size=(cfg.hidden_size, cfg.vocab_size)) * 0.02,
             jnp.float32)
+        # final pre-head LayerNorm, exactly as GPTModel applies after
+        # the layer stack — round 3 omitted it from this hand-rolled
+        # closure model, which is why the leg's init loss read 22.6
+        # (≈ 2x ln(V)): 24 unnormalized residual additions grow the
+        # stream's scale, inflating the logit variance.  Its params
+        # ride loss_params so their grads close over the pipeline.
+        fln_scale = jnp.ones((cfg.hidden_size,), jnp.float32)
+        fln_bias = jnp.zeros((cfg.hidden_size,), jnp.float32)
         params = {"embed": embed, "pos": pos, "stages": stages,
-                  "head": head}
+                  "head": head, "fln_scale": fln_scale,
+                  "fln_bias": fln_bias}
         n_params = sum(x.size for x in jax.tree.leaves(params))
         # bf16 moments (as the gpt2_1p3b proxy leg): XLA:CPU does not
         # honor buffer donation, so the step materializes a second
@@ -441,7 +459,8 @@ def bench_gpt2_3d_full_step():
         # 8 host copies, and with masters+2 moments+grads that alone
         # OOMs the 125 GB host
         emb_spec = {"embed": P(("data", "tensor"), None), "pos": P(),
-                    "head": P(None, ("data", "tensor"))}
+                    "head": P(None, ("data", "tensor")),
+                    "fln_scale": P(), "fln_bias": P()}
 
         # storage spec: additionally ZeRO-shard the per-stage axis over
         # `data` (distributed_fused_adam semantics) — XLA:CPU does not
@@ -497,8 +516,13 @@ def bench_gpt2_3d_full_step():
             lab_mb = labels.reshape(m, mb, s)
 
             def loss_fn(lp, y, i):
-                (hd,) = lp
-                logits = (y @ hd).astype(jnp.float32)
+                hd, g, be = lp
+                # final LN (as GPTModel's post-stack norm), fp32
+                yf = y.astype(jnp.float32)
+                mu = jnp.mean(yf, axis=-1, keepdims=True)
+                var = jnp.var(yf, axis=-1, keepdims=True)
+                yn = (yf - mu) * jax.lax.rsqrt(var + 1e-5) * g + be
+                logits = (yn.astype(y.dtype) @ hd).astype(jnp.float32)
                 lab = jax.lax.dynamic_index_in_dim(
                     lab_mb, jnp.clip(i, 0, m - 1), axis=0,
                     keepdims=False)
@@ -515,15 +539,18 @@ def bench_gpt2_3d_full_step():
             sloss, sgrads, aux = \
                 forward_backward_pipelining_without_interleaving(
                     stage_fn, loss_fn, cp["stages"], h, mesh=mesh,
-                    num_microbatches=m, loss_params=(cp["head"],),
+                    num_microbatches=m,
+                    loss_params=(cp["head"], cp["fln_scale"],
+                                 cp["fln_bias"]),
                     return_input_cotangents=True,
                     distribute_inputs=False)
             cts = aux["input_cotangents"].astype(jnp.float32)
             cts = cts.reshape(m * mb, s, cfg.hidden_size)
             d_embed = jnp.zeros_like(cp["embed"]).at[inputs].add(cts)
-            (d_head,) = aux["loss_params_grads"]
+            d_head, d_flns, d_flnb = aux["loss_params_grads"]
             grads = {"embed": d_embed, "pos": cts.sum(0),
-                     "stages": sgrads, "head": d_head}
+                     "stages": sgrads, "head": d_head,
+                     "fln_scale": d_flns, "fln_bias": d_flnb}
             new_state, finite = state.apply_gradients(grads=grads)
             loss = state.loss_scaler.unscale(
                 state.loss_scale_state, sloss)
@@ -535,12 +562,19 @@ def bench_gpt2_3d_full_step():
         loss = float(loss)
         dt = time.perf_counter() - t0
     assert np.isfinite(loss), f"non-finite loss {loss}"
+    # init-loss plausibility (round-3 verdict item 4): with the final
+    # LN restored this leg must agree with the TP=8 leg's ≈ ln(V)
+    ln_v = float(np.log(cfg.vocab_size))
+    assert 0.8 * ln_v <= loss <= 1.6 * ln_v, (
+        f"init loss {loss} implausible vs ln(V)={ln_v:.3f}")
     _emit({
         "metric": "gpt2_1p3b_tp2pp2dp2_1f1b_train_step_executed",
         "value": 1,
         "unit": "ok",
         "executed": True,
         "loss": round(loss, 4),
+        "loss_over_ln_vocab": round(loss / ln_v, 3),
+        "loss_plausibility_checked": "0.8 <= loss/ln(V) <= 1.6",
         "grads_finite": bool(finite),
         "microbatches": m, "microbatch_size": mb, "seq": s,
         "host_cpu_step_seconds": round(dt, 1),
@@ -694,8 +728,17 @@ def _long_context_single():
         new_state, finite = state.apply_gradients(grads=grads)
         return new_state, loss, finite
 
+    # at 16k+ the step is dominated by the d=64 flash kernels, whose
+    # measured achievable rate is ~93 TFLOP/s (tools/attn_bench.py,
+    # s=32k fwd+bwd useful-flops; the irreducible MXU contraction
+    # padding at d=64 caps it well below chip peak) — give the
+    # roofline self-check that bound so contention_suspect means
+    # contention, not "this kernel class can't reach 197 TFLOP/s"
+    # (round-3 verdict weak #4).  At 8k attention is a minor fraction
+    # of the flops, so the chip-peak bound stays authoritative there.
     out = _measure(state, step, (inputs, labels), b,
-                   {"batch": b, "seq": s})
+                   {"batch": b, "seq": s},
+                   measured_tflops=93.0 if s >= 16384 else None)
     out["tokens_per_sec"] = round(out["value"] * s, 1)
 
     if s == 8192:
@@ -722,13 +765,6 @@ def _long_context_single():
             except Exception as e:                 # composition may not
                 mems[impl] = f"uncompilable: {type(e).__name__}"  # fit
         out["attn_32k_temp_bytes"] = mems
-    if s >= 16384 and "contention_suspect" in (out.get("flags") or []):
-        # investigated (BASELINE.md): at 16k+ the step is bound by the
-        # flash kernel itself (d=64 half-fills the MXU; fp32 VPU
-        # softmax ≈ 19 TFLOP/s kernel rate in isolation), not by
-        # machine contention — the flag is the self-check doing its job
-        out["flag_note"] = ("attention-kernel-bound at d=64, not "
-                            "contention (BASELINE.md long-context row)")
     out["metric"] = f"gpt_long_context_{s//1024}k_O2_samples_per_sec_per_chip"
     _emit(out)
 
@@ -803,37 +839,53 @@ def bench_group_norm():
     w = jnp.ones((c,), jnp.float32)
     bias = jnp.zeros((c,), jnp.float32)
 
-    def loss(x, w, bias):
-        y = group_norm(x, groups, w, bias, act="silu")
-        return jnp.sum(y.astype(jnp.float32) ** 2)
+    # ≥1000 in-jit iterations: the tunneled chip's FIXED ~100 ms
+    # call+sync overhead poisoned every round-3 GN number at the old
+    # 50 steps (÷50 → +2 ms/step on a ~0.3 ms op — the scoreboard's
+    # 2.5 ms/step was ~80% overhead); the measured trivial-call
+    # overhead is also subtracted per window now
+    n_steps = int(os.environ.get("BENCH_STEPS", "0")) or 1000
 
-    n_steps = int(os.environ.get("BENCH_STEPS", "50"))
+    # the timed body is EXACTLY the counted passes (round-3 verdict
+    # weak #1 — the old harness added ~4 uncounted passes): fwd (read
+    # x, write y) + vjp (read dy, read x, write dx), with y and dx
+    # both live in the carry and dy independent of x so XLA can
+    # neither dead-code the forward nor alias dy into the x read
+    dy0 = jnp.asarray(
+        np.random.default_rng(1).normal(size=(b, hw, hw, c)),
+        jnp.bfloat16)
 
-    # iterate INSIDE one jit: per-dispatch overhead on the tunneled
-    # chip (~ms) would otherwise dominate a sub-ms bandwidth op
     @jax.jit
-    def many(x, w, bias):
-        def body(c, _):
-            dx, dw, db = jax.grad(loss, argnums=(0, 1, 2))(c, w, bias)
-            return c + 1e-6 * dx.astype(c.dtype), (dw[0], db[0])
+    def many(x, dy, w, bias):
+        def body(carry, _):
+            xx, dd = carry
+            y, pull = jax.vjp(
+                lambda q: group_norm(q, groups, w, bias, act="silu"),
+                xx)
+            (dx,) = pull(dd)
+            return (dx.astype(xx.dtype), y.astype(dd.dtype)), None
 
-        c, outs = jax.lax.scan(body, x, None, length=n_steps)
-        return c, outs
+        carry, _ = jax.lax.scan(body, (x, dy), None, length=n_steps)
+        return carry
 
-    out = many(x, w, bias)
+    out = many(x, dy0, w, bias)
     bench._sync(out)
+    assert bool(jnp.isfinite(out[0][0, 0, 0]).all()), "diverged"
+    ovh = bench._call_overhead()
 
     def window():
         t0 = time.perf_counter()
-        out = many(x, w, bias)
+        out = many(x, dy0, w, bias)
         bench._sync(out)
-        return (time.perf_counter() - t0) / n_steps
+        return (time.perf_counter() - t0 - ovh) / n_steps
 
     dt, dts = bench._time_windows(
         window, max(1, int(os.environ.get("BENCH_WINDOWS", "3"))))
-    # minimum HBM traffic for fwd+bwd: read x, write y (fwd); read x +
-    # read dy, write dx (bwd) — 5 × numel × 2 bytes in bf16 (stat
-    # reductions are negligible)
+    # HBM traffic of what is timed: read x, write y (fwd); read dy,
+    # read x, write dx (bwd) — 5 × numel × 2 bytes in bf16 (stat
+    # reductions are negligible).  NB the KERNEL's own traffic is
+    # higher (two-phase sweeps re-read x/dy once each: 8 passes); this
+    # metric stays the end-to-end lower-bound form for comparability.
     numel = b * hw * hw * c
     min_bytes = 5 * numel * 2
     gbs = min_bytes / dt / 1e9
@@ -845,6 +897,11 @@ def bench_group_norm():
         "step_us": round(dt * 1e6, 1),
         "window_us": [round(d * 1e6, 1) for d in dts],
         "frac_of_peak_hbm": round(gbs / bench._PEAK_HBM_GBS, 3),
+        "impl_note": (
+            "default impl = XLA composition (measured 2.3x faster "
+            "than the Pallas kernels once the fixed call overhead is "
+            "subtracted — BASELINE.md round-4 GN section); "
+            "APEX_TPU_OPS_IMPL=pallas re-measures the kernels"),
     })
 
 

@@ -1,0 +1,41 @@
+"""Regenerate the checked-in sample shard (deterministic).
+
+``sample_imagenet.npz``: 16 class-separable 32x32x3 uint8 images
+(4 classes; each class a distinct low-frequency pattern + noise,
+quantized) + int64 labels — a few-KB stand-in for one real-dataset
+shard, so the examples' ``--data`` loader branches run in CI and can
+be demoed offline:
+
+    python examples/imagenet/main_amp.py \
+        --data examples/data/sample_imagenet.npz --arch resnet18 \
+        --batch-size 16 --image-size 32 --steps 5
+    python examples/dcgan/main_amp.py \
+        --data examples/data/sample_imagenet.npz --steps 5
+
+Usage: python examples/data/make_sample.py
+"""
+
+import os
+
+import numpy as np
+
+
+def main():
+    rng = np.random.default_rng(1234)
+    n, size, classes = 16, 32, 4
+    labels = rng.integers(0, classes, size=(n,))
+    protos = rng.normal(size=(classes, 8, 8, 3))
+    pats = np.repeat(np.repeat(protos[labels], size // 8, 1),
+                     size // 8, 2)
+    imgs = pats + 0.3 * rng.normal(size=(n, size, size, 3))
+    imgs = (imgs - imgs.min()) / (imgs.max() - imgs.min())
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "sample_imagenet.npz")
+    np.savez_compressed(out,
+                        images=(imgs * 255).astype(np.uint8),
+                        labels=labels.astype(np.int64))
+    print(out, os.path.getsize(out), "bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,10 @@ def main():
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--zdim", type=int, default=64)
     p.add_argument("--opt-level", default="O1")
+    p.add_argument("--data", default=None, metavar="FILE.npz",
+                   help="npz with an `images` array (NHWC, 32x32, "
+                        "uint8 or float) as the real distribution; "
+                        "default: synthetic noise images")
     args = p.parse_args()
 
     gen, disc = Generator(), Discriminator()
@@ -78,8 +82,24 @@ def main():
         fused_adam(2e-4, b1=0.5), opt_level=args.opt_level)
 
     rng = np.random.default_rng(0)
-    real = jnp.asarray(
-        rng.normal(size=(args.batch_size, 32, 32, 3)), jnp.float32)
+    if args.data:
+        raw = np.load(args.data)["images"]
+        if raw.shape[1:] != (32, 32, 3):
+            raise ValueError(
+                f"dcgan expects (N, 32, 32, 3) images, got {raw.shape}")
+        if raw.shape[0] < args.batch_size:
+            # D must see as many reals as fakes per step
+            print(f"# shard has {raw.shape[0]} images < batch-size "
+                  f"{args.batch_size}; clamping batch size")
+            args.batch_size = raw.shape[0]
+        raw = raw[: args.batch_size]
+        if raw.dtype == np.uint8:
+            raw = raw.astype(np.float32) / 255.0
+        # map into the generator's tanh range
+        real = jnp.asarray(raw * 2.0 - 1.0, jnp.float32)
+    else:
+        real = jnp.asarray(
+            rng.normal(size=(args.batch_size, 32, 32, 3)), jnp.float32)
 
     @jax.jit
     def step(g_state, d_state, z):

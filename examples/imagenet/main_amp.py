@@ -84,8 +84,10 @@ def main():
     shape = (args.batch_size, args.image_size, args.image_size, 3)
     if args.data:
         blob = np.load(args.data)
-        images = jnp.asarray(blob["images"][: args.batch_size],
-                             jnp.float32)
+        raw = blob["images"][: args.batch_size]
+        if raw.dtype == np.uint8:      # shards ship uint8 pixels
+            raw = raw.astype(np.float32) / 255.0
+        images = jnp.asarray(raw, jnp.float32)
         labels = jnp.asarray(blob["labels"][: args.batch_size])
     elif args.synthetic_learnable:
         # class-conditional means: each class is a distinct low-freq

@@ -31,9 +31,28 @@ def _run_example(script, args, timeout=900):
         env=env, capture_output=True, text=True, timeout=timeout)
 
 
+_SAMPLE_NPZ = os.path.join(_REPO, "examples", "data",
+                           "sample_imagenet.npz")
+
+
 class TestImagenetExample:
+    def test_checked_in_shard_trains(self):
+        # the in-repo uint8 sample shard (examples/data, regenerable
+        # via make_sample.py) through the real --data loader branch
+        r = _run_example(
+            "examples/imagenet/main_amp.py",
+            ["--data", _SAMPLE_NPZ, "--arch", "resnet18",
+             "--batch-size", "16", "--image-size", "32",
+             "--steps", "3", "--opt-level", "O2"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        # num_classes must have been derived from the npz labels, and
+        # the printed losses must be finite
+        losses = re.findall(r"loss (\d+\.\d+)", r.stdout)
+        assert losses, r.stdout[-2000:]
+        assert all(np.isfinite(float(l)) for l in losses)
+
     def test_npz_data_branch_trains(self, tmp_path, rng):
-        # tiny class-separable dataset through the real --data loader
+        # tiny class-separable float32 dataset through the same loader
         n, size, classes = 16, 32, 4
         labels = rng.integers(0, classes, size=(n,))
         protos = rng.normal(size=(classes, size, size, 3))
@@ -49,8 +68,6 @@ class TestImagenetExample:
              "--batch-size", "16", "--image-size", str(size),
              "--steps", "3", "--opt-level", "O2"])
         assert r.returncode == 0, r.stderr[-2000:]
-        # num_classes must have been derived from the npz labels, and
-        # the printed losses must be finite
         losses = re.findall(r"loss (\d+\.\d+)", r.stdout)
         assert losses, r.stdout[-2000:]
         assert all(np.isfinite(float(l)) for l in losses)
@@ -68,6 +85,21 @@ class TestImagenetExample:
              "--batch-size", "8", "--image-size", "32",
              "--steps", "1"])
         assert r.returncode == 0, r.stderr[-2000:]
+
+
+class TestDCGANExample:
+    def test_checked_in_shard_real_branch(self):
+        # the dcgan --data branch (real images as the D's positive
+        # distribution) on the in-repo shard
+        r = _run_example(
+            "examples/dcgan/main_amp.py",
+            ["--data", _SAMPLE_NPZ, "--batch-size", "16",
+             "--steps", "2"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        pairs = re.findall(r"G (\d+\.\d+)\s+D (\d+\.\d+)", r.stdout)
+        assert len(pairs) == 2, r.stdout[-1000:]
+        assert all(np.isfinite(float(g)) and np.isfinite(float(d))
+                   for g, d in pairs)
 
 
 class TestTransformerTPExample:

@@ -170,6 +170,63 @@ class TestFusedAdamFP8Moments:
         with pytest.raises(ValueError, match="moment_format"):
             ao.fused_adam(moment_format="fp4")
 
+    @pytest.mark.parametrize("wd,adamw", [(0.0, True), (0.01, True),
+                                          (0.01, False)])
+    def test_pallas_kernel_matches_xla_path(self, rng, monkeypatch,
+                                            wd, adamw):
+        # the fused dequant-update-requant kernel (interpret mode) must
+        # produce the same updates and quantized state as the XLA
+        # composition, for leaves large enough to take the kernel path
+        import importlib
+
+        fa = importlib.import_module("apex_tpu.optim.fused_adam")
+
+        n = fa._FP8_KERNEL_MIN + 300        # ragged tail rows too
+        params = {"w": jnp.asarray(rng.normal(size=(n,)), jnp.float32)}
+        g = {"w": jnp.asarray(rng.normal(size=(n,)) * 1e-3,
+                              jnp.float32)}
+        tx = ao.fused_adam(3e-3, weight_decay=wd, adam_w_mode=adamw,
+                           moment_format="fp8_block_scaled")
+
+        def run(impl):
+            monkeypatch.setenv("APEX_TPU_OPS_IMPL", impl)
+            st = tx.init(params)
+            outs = []
+            p = params
+            for i in range(3):
+                u, st = tx.update(g, st, p)
+                p = optax.apply_updates(p, u)
+                outs.append((u, st))
+            return outs
+
+        xla = run("xla")
+        ker = run("pallas_interpret")
+        for i, ((ux, sx), (uk, sk)) in enumerate(zip(xla, ker)):
+            # not bitwise: the two compilations round differently (FMA
+            # contraction) and a flipped e4m3 quantum near a rounding
+            # boundary shifts later steps by ~one fp8 ulp — compare at
+            # semantic tolerances instead
+            np.testing.assert_allclose(
+                np.asarray(uk["w"]), np.asarray(ux["w"]),
+                rtol=1e-3, atol=1e-8, err_msg=f"update step {i}")
+            for field in ("exp_avg", "exp_avg_sq"):
+                a = getattr(sx, field)["w"]
+                b = getattr(sk, field)["w"]
+                da = np.asarray(a["q"].astype(jnp.float32)
+                                ) * np.repeat(np.asarray(a["scale"]), 256)
+                db = np.asarray(b["q"].astype(jnp.float32)
+                                ) * np.repeat(np.asarray(b["scale"]), 256)
+                # a flipped quantum is one e4m3 ulp of the block scale
+                atol = np.repeat(np.asarray(a["scale"]), 256) * 2.0
+                bad = np.abs(db - da) > 0.15 * np.abs(da) + atol
+                assert not bad.any(), (
+                    f"{field} dequant step {i}: {bad.sum()} elements "
+                    f"beyond one-quantum tolerance")
+                # block magnitudes must agree tightly
+                np.testing.assert_allclose(
+                    np.asarray(b["scale"]), np.asarray(a["scale"]),
+                    rtol=1e-3, err_msg=f"{field} scale step {i}")
+
     def test_o2_apply_gradients_and_skip_step(self):
         # fp8 moment leaves must survive the full O2 path: bf16-grad
         # upcast, unscale, finiteness select (jnp.where over float8

@@ -473,6 +473,141 @@ class TestBuildModel:
                         sample_input=jnp.zeros((2, 8, 32)))
 
 
+class Test3DConvergence:
+    """Multi-step convergence through the FULL 3D composed path
+    (round-3 verdict item 7): build_model TP stages + 1F1B +
+    loss_params/return_input_cotangents closure + FusedAdam + dynamic
+    loss scaling, ~20 optimizer steps on the tp2×pp2×dp2 mesh — the
+    loss must DECREASE and track the no-pipelining composition's
+    trajectory.  A single-step finite-loss check cannot catch
+    accumulated-state bugs (optimizer moments, loss-scale state,
+    closure grads); this is the cheapest test that can."""
+
+    def test_loss_decreases_and_tracks_reference(self, rng, mesh8):
+        from jax.sharding import NamedSharding
+        from apex_tpu import amp
+        from apex_tpu.optim import fused_adam
+        from apex_tpu.transformer.pipeline_parallel import build_model
+
+        m, voc, seq, hid = 2, 64, 8, 32
+        layer = _tiny_layer()
+        x0 = jnp.zeros((MB, seq, hid), jnp.float32)
+        stage_fn, stacked, spec = build_model(
+            layer, 4, 2, rng=jax.random.PRNGKey(0), sample_input=x0)
+        embed = jnp.asarray(rng.normal(size=(voc, hid)) * 0.3,
+                            jnp.float32)
+        head = jnp.asarray(rng.normal(size=(hid, voc)) * 0.3,
+                           jnp.float32)
+        ids = jnp.asarray(rng.integers(0, voc, size=(m * MB, seq)),
+                          jnp.int32)
+        labels = jnp.asarray(rng.integers(0, voc, size=(m * MB, seq)),
+                             jnp.int32)
+        lab_mb = labels.reshape(m, MB, seq)
+        params = {"embed": embed, "head": head, "stages": stacked}
+        # lr small enough for a smooth monotone-ish descent: at 5e-2
+        # the trajectory is chaotic and fp roundoff between the two
+        # compilations diverges the runs (measured), proving nothing
+        n_steps = 20
+
+        def loss_fn(lp, y, i):
+            (hd,) = lp
+            logits = y @ hd
+            lab = jax.lax.dynamic_index_in_dim(
+                lab_mb, jnp.clip(i, 0, m - 1), axis=0, keepdims=False)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, lab[..., None], -1))
+
+        def run_pipelined():
+            state = amp.initialize(
+                None, params, fused_adam(5e-3), opt_level="O2",
+                half_dtype=jnp.float32)   # f32 compute on XLA:CPU
+            with jax.set_mesh(mesh8):
+                place = {"embed": P(), "head": P(), "stages": spec}
+                state = state.replace(params=jax.tree.map(
+                    lambda s, a: jax.device_put(
+                        a, NamedSharding(mesh8, s)) if isinstance(
+                            s, P) else a,
+                    place, state.params,
+                    is_leaf=lambda x: isinstance(x, P)))
+
+                @jax.jit
+                def step(state):
+                    cp = state.policy.cast_to_compute(state.params)
+
+                    def scaled_loss(lp, y, i):
+                        return state.scale_loss(loss_fn(lp, y, i))
+
+                    h = jnp.take(cp["embed"], ids, axis=0)
+                    sloss, sgrads, aux = \
+                        forward_backward_pipelining_without_interleaving(
+                            stage_fn, scaled_loss, cp["stages"], h,
+                            mesh=mesh8, num_microbatches=m,
+                            loss_params=(cp["head"],),
+                            return_input_cotangents=True)
+                    cts = aux["input_cotangents"].reshape(
+                        m * MB, seq, hid)
+                    d_embed = jnp.zeros_like(cp["embed"]).at[ids].add(
+                        cts)
+                    (d_head,) = aux["loss_params_grads"]
+                    grads = {"embed": d_embed, "head": d_head,
+                             "stages": sgrads}
+                    new_state, finite = state.apply_gradients(
+                        grads=grads)
+                    loss = state.loss_scaler.unscale(
+                        state.loss_scale_state, sloss)
+                    return new_state, loss, finite
+
+                losses = []
+                for _ in range(n_steps):
+                    state, loss, finite = step(state)
+                    losses.append(float(loss))
+                    assert bool(finite)
+            return losses
+
+        def run_reference():
+            state = amp.initialize(
+                None, params, fused_adam(5e-3), opt_level="O2",
+                half_dtype=jnp.float32)
+
+            def full_loss(p):
+                h = jnp.take(p["embed"], ids, axis=0).reshape(
+                    m, MB, seq, hid)
+
+                def one(mb_i, i):
+                    x = mb_i
+                    for r in range(2):
+                        sp = jax.tree.map(lambda t: t[r], p["stages"])
+                        x = stage_fn(sp, x)
+                    return loss_fn((p["head"],), x, i)
+
+                return jnp.mean(jax.vmap(one)(h, jnp.arange(m)))
+
+            @jax.jit
+            def step(state):
+                def scaled(p):
+                    l = full_loss(p)
+                    return state.scale_loss(l), l
+
+                grads, loss = jax.grad(scaled, has_aux=True)(
+                    state.params)
+                new_state, finite = state.apply_gradients(grads=grads)
+                return new_state, loss, finite
+
+            losses = []
+            for _ in range(n_steps):
+                state, loss, finite = step(state)
+                losses.append(float(loss))
+            return losses
+
+        got = run_pipelined()
+        want = run_reference()
+        # converging: clearly below the start by the end
+        assert got[-1] < got[0] - 0.5, got
+        # and tracking the no-pipelining trajectory step for step
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+
 class TestCollectiveDetection:
     """schedules auto-select computed-and-masked ticks when the stage
     or loss body traces collectives (cond-skipping would deadlock)."""
