@@ -14,12 +14,14 @@ from apex_tpu.models.transformer import (
     ParallelMLP,
 )
 from apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
+from apex_tpu.models.llama import LlamaConfig, LlamaModel
 from apex_tpu.models.bert import BertConfig, BertModel, bert_mlm_loss_fn
 from apex_tpu.models.resnet import ResNetConfig, ResNet, resnet50, resnet18
 from apex_tpu.models.vit import ViTConfig, ViTModel
 
 __all__ = [
     "load_torch_gpt2",
+    "load_torch_llama",
     "TransformerConfig",
     "ParallelTransformer",
     "ParallelTransformerLayer",
@@ -28,10 +30,18 @@ __all__ = [
     "GPTConfig",
     "GPTModel",
     "gpt_loss_fn",
+    "LlamaConfig",
+    "LlamaModel",
     "BertConfig",
     "BertModel",
     "bert_mlm_loss_fn",
     "ResNetConfig", "ResNet", "resnet50", "resnet18",
     "ViTConfig", "ViTModel",
 ]
-from apex_tpu.models.torch_import import load_torch_gpt2  # noqa: E402
+from apex_tpu.models.torch_import import (  # noqa: E402
+    load_torch_gpt2,
+    load_torch_llama,
+)
+from apex_tpu.models.generate import generate, init_cache  # noqa: E402
+
+__all__ += ["generate", "init_cache"]

@@ -1,0 +1,148 @@
+"""Autoregressive generation over the KV-cached decoder models.
+
+The reference is a training library (no inference engine), but "switch
+frameworks and find everything you need" includes sampling from the
+model you just trained.  This module is the minimal TPU-idiomatic
+decode loop over :class:`~apex_tpu.models.gpt.GPTModel` /
+:class:`~apex_tpu.models.llama.LlamaModel`'s ``decode=True`` path:
+
+- the KV cache is a plain pytree (``init_cache`` — all-zero arrays of
+  shape ``(b, max_seq_len, kv_heads, head_dim)`` per layer; GQA shrinks
+  it by ``num_heads/num_kv_heads``),
+- prefill is ONE model call over the whole prompt (flash path
+  unnecessary: decode attention masks by absolute position),
+- the per-token loop is a ``lax.scan`` inside one ``jit`` — no host
+  round-trips between tokens; greedy or temperature/top-k sampling via
+  ``jax.random.categorical``.
+
+Static-shape discipline: prompts share one length (pad-free; ragged
+batches should be bucketed by the caller) and ``max_new_tokens`` is
+static.  The compiled loop is cached per ``(model, max_new_tokens,
+temperature, top_k, eos_id)`` signature (jit handles the shape axis),
+so repeated same-shape calls do not retrace.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_cache", "generate"]
+
+
+@functools.lru_cache(maxsize=64)
+def _cache_shapes(model, batch_size: int, prompt_len: int):
+    """Memoized cache structure: one abstract trace of ``model.init``
+    per (model, batch) signature — repeated generate() calls skip the
+    whole-model eval_shape."""
+    ids = jnp.zeros((batch_size, prompt_len), jnp.int32)
+    return jax.eval_shape(
+        functools.partial(model.init, decode=True),
+        jax.random.PRNGKey(0), ids)["cache"]
+
+
+def init_cache(model, batch_size: int, *, prompt_len: int = 1,
+               rng=None) -> Any:
+    """Build an all-zero KV cache pytree for ``model``.
+
+    Uses ``jax.eval_shape`` over ``model.init`` to learn the cache
+    structure without materializing parameters; every cache leaf's init
+    value is zeros (arrays) or 0 (indices), so zeros-from-shape IS the
+    initialized cache.  ``rng`` is accepted for API symmetry but never
+    materialized (the trace is abstract).
+    """
+    del rng
+    shapes = _cache_shapes(model, batch_size, prompt_len)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_run(model, max_new_tokens: int, temperature: float,
+                  top_k: Optional[int], eos_id: Optional[int]):
+    """One jitted prefill+scan loop per static signature.
+
+    ``model`` is a frozen flax module (hashable); jit's own cache
+    handles the (batch, prompt_len) shape axis on top.
+    """
+
+    def next_token(logits, key):
+        logits = logits[:, -1].astype(jnp.float32)
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+            scaled = jnp.where(scaled < kth, -1e30, scaled)
+        return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+    @jax.jit
+    def run(variables, cache, prompt_ids, rng):
+        b = prompt_ids.shape[0]
+        # prefill: one pass over the prompt populates every layer cache
+        logits, updated = model.apply(
+            {**variables, "cache": cache}, prompt_ids,
+            deterministic=True, decode=True, mutable=["cache"])
+        rng, key = jax.random.split(rng)
+        tok = next_token(logits, key)
+        # eos latches only on PRODUCED tokens — a prompt-contained
+        # eos_id (bos/document-separator usage) must not kill the batch
+        done0 = jnp.zeros((b,), bool)
+
+        def step(carry, _):
+            cache, tok, done, rng = carry
+            logits, upd = model.apply(
+                {**variables, "cache": cache}, tok[:, None],
+                deterministic=True, decode=True, mutable=["cache"])
+            rng, key = jax.random.split(rng)
+            nxt = next_token(logits, key)
+            if eos_id is not None:
+                done = done | (tok == eos_id)
+                nxt = jnp.where(done, eos_id, nxt)
+            return (upd["cache"], nxt, done, rng), tok
+
+        (_, last, _, _), toks = jax.lax.scan(
+            step, (updated["cache"], tok, done0, rng), None,
+            length=max_new_tokens - 1)
+        toks = jnp.moveaxis(toks, 0, 1)              # (b, n-1)
+        return jnp.concatenate(
+            [prompt_ids, toks, last[:, None]], axis=1)
+
+    return run
+
+
+def generate(model, params, prompt_ids, *, max_new_tokens: int,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             rng=None, eos_id: Optional[int] = None):
+    """Generate ``max_new_tokens`` continuations of ``prompt_ids``.
+
+    ``prompt_ids``: ``(batch, prompt_len)`` int32 (one shared length —
+    bucket ragged prompts before calling).  ``temperature=0`` is greedy
+    argmax; otherwise logits/temperature are sampled (optionally top-k
+    truncated).  After ``eos_id`` is *produced* a sequence keeps
+    emitting ``eos_id`` (static shapes — no early exit under jit);
+    eos tokens already in the prompt are ignored.
+
+    Returns ``(batch, prompt_len + max_new_tokens)`` token ids.
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    b, prompt_len = prompt_ids.shape
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    max_len = model.cfg.max_seq_len
+    if prompt_len + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt_len ({prompt_len}) + max_new_tokens "
+            f"({max_new_tokens}) exceeds the model's max_seq_len "
+            f"({max_len}) — the KV cache cannot hold the sequence")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature>0) needs an rng key")
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    cache = init_cache(model, b)
+    run = _compiled_run(model, int(max_new_tokens), float(temperature),
+                        None if top_k is None else int(top_k),
+                        None if eos_id is None else int(eos_id))
+    return run(dict(params), cache, prompt_ids, rng)

@@ -70,7 +70,8 @@ class GPTModel(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, input_ids, *, deterministic: bool = True):
+    def __call__(self, input_ids, *, deterministic: bool = True,
+                 decode: bool = False):
         cfg = self.cfg
         emb = VocabParallelEmbedding(
             num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
@@ -81,10 +82,21 @@ class GPTModel(nn.Module):
             pos_table = self.param(
                 "position_embedding", nn.initializers.normal(0.02),
                 (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
-            x = x + pos_table[None, : x.shape[1]].astype(x.dtype)
+            if decode:
+                # incremental decoding: positions continue from the
+                # model-level cache index (the per-layer attention
+                # caches track their own — they advance in lockstep)
+                pi = self.variable("cache", "position_index",
+                                   lambda: jnp.array(0, jnp.int32))
+                pos = jax.lax.dynamic_slice_in_dim(
+                    pos_table, pi.value, x.shape[1], 0)
+                pi.value = pi.value + x.shape[1]
+                x = x + pos[None].astype(x.dtype)
+            else:
+                x = x + pos_table[None, : x.shape[1]].astype(x.dtype)
         x = x.astype(cfg.dtype)
         x = ParallelTransformer(cfg, name="transformer")(
-            x, deterministic=deterministic)
+            x, deterministic=deterministic, decode=decode)
         x = _norm(cfg, "final_norm")(x).astype(cfg.dtype)
         if cfg.tie_embeddings:
             logits = emb.attend(x)

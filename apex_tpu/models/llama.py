@@ -1,0 +1,90 @@
+"""Llama-family decoder models (RMSNorm + RoPE + SwiGLU + GQA).
+
+The reference has no Llama model (apex predates it), but its transformer
+recipe — `apex/transformer` TP layers + fused norm/rope/attention
+kernels (SURVEY.md §2.4, §2.6) — is exactly the toolbox the family
+needs.  This module is the config preset over the same
+:class:`~apex_tpu.models.gpt.GPTModel` core: untied vocab head, RMSNorm
+(:func:`~apex_tpu.ops.layer_norm.fused_rms_norm` — the reference's
+FusedRMSNorm row), NeoX/Llama half-rotation RoPE
+(:mod:`apex_tpu.ops.rope`), gated SwiGLU MLP, no linear biases, and
+grouped-query attention via the flash kernel's native kv-head support
+(``ops/attention.py``).
+
+Every parallel feature composes unchanged: TP/SP via the GSPMD layer
+specs, pipeline via ``build_model``, GQA's kv heads shard over the
+``tensor`` axis like q heads (``num_kv_heads`` must be divisible by the
+TP degree or replicated — see ``docs/parallelism.md``).
+
+Checkpoint migration: :func:`apex_tpu.models.torch_import.load_torch_llama`
+maps a HuggingFace ``LlamaForCausalLM`` state dict (including GQA
+models) onto these parameters; cross-framework logits agreement is
+asserted in ``tests/test_models.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+
+__all__ = ["LlamaConfig", "LlamaModel"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig(GPTConfig):
+    """Llama architecture defaults over the shared transformer config."""
+
+    norm: str = "rmsnorm"
+    position_embedding: str = "rope"
+    activation: str = "silu"
+    gated_mlp: bool = True
+    add_bias_linear: bool = False
+    tie_embeddings: bool = False
+    rope_base: float = 10000.0
+    # HF LlamaConfig's rms_norm_eps default; at init-scale activations
+    # (std 0.02) an eps off by 10x shifts every norm output by ~1%
+    layernorm_eps: float = 1e-6
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test-size config (GQA by default: 4 q heads over 2 kv heads)."""
+        kw.setdefault("vocab_size", 1024)
+        kw.setdefault("hidden_size", 256)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("num_kv_heads", 2)
+        kw.setdefault("ffn_hidden_size", 512)
+        kw.setdefault("max_seq_len", 256)
+        return cls(**kw)
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "LlamaConfig":
+        kw.setdefault("layernorm_eps", 1e-5)
+        kw.setdefault("vocab_size", 32000)
+        kw.setdefault("hidden_size", 4096)
+        kw.setdefault("num_layers", 32)
+        kw.setdefault("num_heads", 32)
+        kw.setdefault("ffn_hidden_size", 11008)
+        kw.setdefault("max_seq_len", 4096)
+        return cls(**kw)
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        """GQA sizing (8 kv heads), 128k vocab, rope theta 5e5."""
+        kw.setdefault("layernorm_eps", 1e-5)
+        kw.setdefault("vocab_size", 128256)
+        kw.setdefault("hidden_size", 4096)
+        kw.setdefault("num_layers", 32)
+        kw.setdefault("num_heads", 32)
+        kw.setdefault("num_kv_heads", 8)
+        kw.setdefault("ffn_hidden_size", 14336)
+        kw.setdefault("max_seq_len", 8192)
+        kw.setdefault("rope_base", 500000.0)
+        return cls(**kw)
+
+
+# The Llama architecture is GPTModel under the Llama config: the module
+# tree (and thus the checkpoint layout) is identical, only the recipe
+# knobs differ.  An alias keeps the model zoo's naming explicit.
+LlamaModel = GPTModel

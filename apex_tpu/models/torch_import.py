@@ -1,11 +1,13 @@
-"""Import torch (HuggingFace-format) GPT-2 weights into apex_tpu models.
+"""Import torch (HuggingFace-format) weights into apex_tpu models.
 
 Migration machinery: a user of the reference trains on torch — switching
 frameworks means bringing checkpoints along.  :func:`load_torch_gpt2`
 maps a ``GPT2LMHeadModel``/``GPT2Model`` state dict onto
 :class:`apex_tpu.models.GPTModel` parameters (both architectures are
-pre-LN with tied embeddings, so the mapping is exact — verified by the
-cross-framework logits test in ``tests/test_models.py``).
+pre-LN with tied embeddings, so the mapping is exact);
+:func:`load_torch_llama` maps a ``LlamaForCausalLM`` state dict
+(including GQA models) onto the Llama recipe.  Both are verified by
+cross-framework logits tests in ``tests/test_models.py``.
 
 Notes on conventions:
 
@@ -34,7 +36,7 @@ from typing import Any, Mapping
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["load_torch_gpt2"]
+__all__ = ["load_torch_gpt2", "load_torch_llama"]
 
 
 def _to_np(x) -> np.ndarray:
@@ -62,28 +64,33 @@ def _set_leaf(leaf, value: np.ndarray):
 
 def _qkv_flat_to_grouped(w: np.ndarray, num_heads: int,
                          num_kv_heads: int | None = None) -> np.ndarray:
-    """Permute a flat ``[q|k|v]`` output axis (HF c_attn) into the
-    per-head-grouped ``[q_i k_i v_i]`` layout of ``qkv_proj``.
+    """Permute a flat ``[q|k|v]`` output axis into the per-kv-group
+    ``[q_{g·rep} … q_{g·rep+rep-1}, k_g, v_g]`` layout of ``qkv_proj``
+    (the grouped reshape in ``ParallelAttention``).
 
-    Only the MHA layout (``num_kv_heads == num_heads``) is implemented:
-    GPT-2 checkpoints are always MHA.  A GQA flat layout (fewer kv than
-    q heads) needs a different ``[q_g*rep.., k_g, v_g]`` permutation —
-    guarded here so mismatched weights can never be silently imported.
+    Flat input layout: ``[q (h·d) | k (hk·d) | v (hk·d)]`` with heads
+    laid out head-major within each part (HF c_attn for MHA; the
+    q|k|v concat of separate projections for GQA).  For MHA
+    (``hk == h``, rep=1) this reduces to the classic per-head
+    ``[q_i k_i v_i]`` interleave.
     """
-    if num_kv_heads is not None and num_kv_heads != num_heads:
-        raise NotImplementedError(
-            f"_qkv_flat_to_grouped only implements the MHA layout; got "
-            f"num_kv_heads={num_kv_heads} != num_heads={num_heads}. "
-            f"Import GQA checkpoints with qkv_grouped=False or add the "
-            f"grouped-GQA permutation.")
-    out = w.shape[-1]
-    if out % (3 * num_heads):
+    h = num_heads
+    hk = num_kv_heads or num_heads
+    if h % hk:
         raise ValueError(
-            f"c_attn output dim {out} not divisible by 3*num_heads="
-            f"{3 * num_heads}")
-    d = out // (3 * num_heads)
-    idx = np.arange(out).reshape(3, num_heads, d)
-    perm = idx.transpose(1, 0, 2).reshape(-1)       # head-major
+            f"num_heads={h} not divisible by num_kv_heads={hk}")
+    rep = h // hk
+    out = w.shape[-1]
+    if out % (h + 2 * hk):
+        raise ValueError(
+            f"qkv output dim {out} not divisible by num_heads+"
+            f"2*num_kv_heads={h + 2 * hk}")
+    d = out // (h + 2 * hk)
+    q_idx = np.arange(h * d).reshape(hk, rep, d)
+    k_idx = (h * d + np.arange(hk * d)).reshape(hk, 1, d)
+    v_idx = ((h + hk) * d + np.arange(hk * d)).reshape(hk, 1, d)
+    # per group g: rep q heads, then k_g, then v_g
+    perm = np.concatenate([q_idx, k_idx, v_idx], axis=1).reshape(-1)
     return np.ascontiguousarray(w[..., perm])
 
 
@@ -119,8 +126,9 @@ def load_torch_gpt2(params: Any, state_dict: Mapping[str, Any], *,
     ``num_heads``: the model's attention head count — needed to permute
     c_attn's flat [q|k|v] columns into qkv_proj's per-head-grouped
     layout.  ``num_kv_heads``: pass the model's kv-head count when it
-    differs from ``num_heads`` — the grouped GQA permutation is not
-    implemented, so a mismatch raises instead of silently mispermuting.  ``qkv_grouped`` must match the model's
+    differs from ``num_heads`` (GQA flat checkpoints) — the
+    ``[q_{g·rep}.., k_g, v_g]`` grouped permutation is applied per
+    kv group.  ``qkv_grouped`` must match the model's
     ``TransformerConfig.qkv_grouped`` (pass ``False`` for models built
     with the flat layout, e.g. single-chip long-context configs).
     """
@@ -175,45 +183,150 @@ def load_torch_gpt2(params: Any, state_dict: Mapping[str, Any], *,
         tree["lm_head"]["kernel"] = _set_leaf(
             tree["lm_head"]["kernel"], head)
 
-    trans = tree["transformer"]
-    def check_layer_count(n_layers):
-        if f"h.{n_layers}.ln_1.weight" in sd:
-            extra = sum(1 for k in sd if k.endswith(".ln_1.weight"))
-            raise ValueError(
-                f"torch checkpoint has {extra} layers but the model "
-                f"has {n_layers} — refusing to silently truncate")
+    n_ckpt = sum(1 for k in sd if k.endswith(".ln_1.weight"))
+    _write_layers(
+        tree["transformer"], n_ckpt,
+        lambda i: {path: fetch(key)
+                   for key, path in _layer_mapping(i).items()})
+    return {"params": tree} if wrapped else tree
+
+
+def _check_layer_count(n_ckpt: int, n_layers: int):
+    if n_ckpt != n_layers:
+        raise ValueError(
+            f"torch checkpoint has {n_ckpt} layers but the model "
+            f"has {n_layers} — refusing to silently truncate")
+
+
+def _write_layers(trans, n_ckpt: int, values_of):
+    """Write per-layer target arrays into the transformer subtree —
+    shared by every importer.  ``values_of(i)`` returns ``{path-tuple:
+    np.ndarray}`` for checkpoint layer ``i``; handles both the unrolled
+    (``layer_{i}``) and scanned (stacked ``layers/layer``) forms."""
+    def put_into(root, path, val):
+        node = root
+        for p in path[:-1]:
+            node = node[p]
+        node[path[-1]] = _set_leaf(node[path[-1]], val)
 
     if any(k.startswith("layer_") for k in trans):
         n_layers = sum(k.startswith("layer_") for k in trans)
-        check_layer_count(n_layers)
+        _check_layer_count(n_ckpt, n_layers)
         for i in range(n_layers):
-            for key, path in _layer_mapping(i).items():
-                put(("transformer", f"layer_{i}") + path, key)
+            for path, val in values_of(i).items():
+                put_into(trans[f"layer_{i}"], path, val)
     else:
         # scanned form: stack each leaf across layers on a new axis 0
         sub = trans["layers"]["layer"]
+        v0 = values_of(0)
+        probe = sub
+        for p in next(iter(v0)):
+            probe = probe[p]
+        n_layers = (probe.unbox().shape[0]
+                    if hasattr(probe, "unbox") else probe.shape[0])
+        _check_layer_count(n_ckpt, n_layers)
+        per_layer = [v0] + [values_of(i) for i in range(1, n_layers)]
+        for path in v0:
+            put_into(sub, path,
+                     np.stack([per_layer[i][path]
+                               for i in range(n_layers)]))
 
-        def stacked(path):
-            node = sub
-            for p in path:
-                node = node[p]
-            n_layers = (node.unbox().shape[0]
-                        if hasattr(node, "unbox") else node.shape[0])
-            return node, n_layers
 
-        # iterate the mapping of layer 0 to learn the paths, then stack
-        checked = False
-        for key0, path in _layer_mapping(0).items():
-            node, n_layers = stacked(path)
-            if not checked:
-                check_layer_count(n_layers)
-                checked = True
-            suffix = key0[len("h.0."):]
-            vals = np.stack([
-                fetch(f"h.{i}.{suffix}") for i in range(n_layers)])
-            target = sub
-            for p in path[:-1]:
-                target = target[p]
-            target[path[-1]] = _set_leaf(target[path[-1]], vals)
+# --------------------------------------------------------------------- #
+# Llama (HF LlamaForCausalLM) import
+# --------------------------------------------------------------------- #
+def _llama_layer_values(sd, i: int, num_heads: int,
+                        num_kv_heads: int,
+                        qkv_grouped: bool = True) -> dict:
+    """Per-layer target arrays (our subtree path → value) for HF layer i.
 
+    HF ``nn.Linear`` weights are (out, in) — transposed to the flax
+    (in, out) kernel.  q/k/v are separate projections; their transposed
+    concat is the flat ``[q|k|v]`` layout, permuted into the grouped
+    ``qkv_proj`` columns by :func:`_qkv_flat_to_grouped` (GQA included).
+    """
+    p = f"model.layers.{i}."
+
+    def lin(key):
+        if key not in sd:
+            raise KeyError(
+                f"torch state dict is missing '{key}' (have e.g. "
+                f"{sorted(sd)[:4]}...)")
+        return _to_np(sd[key]).T
+
+    qkv_flat = np.concatenate(
+        [lin(p + "self_attn.q_proj.weight"),
+         lin(p + "self_attn.k_proj.weight"),
+         lin(p + "self_attn.v_proj.weight")], axis=-1)
+    qkv = (_qkv_flat_to_grouped(qkv_flat, num_heads, num_kv_heads)
+           if qkv_grouped else qkv_flat)
+    return {
+        ("input_norm", "scale"):
+            _to_np(sd[p + "input_layernorm.weight"]),
+        ("attention", "qkv_proj", "kernel"): qkv,
+        ("attention", "out_proj", "kernel"):
+            lin(p + "self_attn.o_proj.weight"),
+        ("post_attention_norm", "scale"):
+            _to_np(sd[p + "post_attention_layernorm.weight"]),
+        ("mlp", "dense_h_to_4h_gate", "kernel"):
+            lin(p + "mlp.gate_proj.weight"),
+        ("mlp", "dense_h_to_4h", "kernel"):
+            lin(p + "mlp.up_proj.weight"),
+        ("mlp", "dense_4h_to_h", "kernel"):
+            lin(p + "mlp.down_proj.weight"),
+    }
+
+
+def load_torch_llama(params: Any, state_dict: Mapping[str, Any], *,
+                     num_heads: int,
+                     num_kv_heads: int | None = None,
+                     qkv_grouped: bool = True) -> Any:
+    """Map a HF ``LlamaForCausalLM`` state dict onto Llama/GPT params.
+
+    The target model must be built with the Llama recipe
+    (:class:`apex_tpu.models.llama.LlamaConfig`: rmsnorm + rope +
+    gated_mlp + no biases + untied head + ``qkv_grouped=True``).  GQA
+    checkpoints work: pass the checkpoint's ``num_key_value_heads`` as
+    ``num_kv_heads`` and the q/k/v projections are packed per kv group
+    to match ``ParallelAttention``'s grouped reshape (``qkv_grouped``
+    must match the model config, as for GPT-2).  Both unrolled
+    (``layer_{i}``) and scanned parameter forms are handled, and
+    ``nn.Partitioned``-boxed leaves keep their sharding metadata.
+
+    RoPE conventions agree by construction: HF Llama's rotate-half and
+    this library's :func:`~apex_tpu.ops.rope.fused_rope` both rotate
+    the (i, i+d/2) channel pairs.
+    """
+    hk = num_kv_heads or num_heads
+    sd = dict(state_dict)
+
+    wrapped = "params" in params
+    import copy
+
+    tree = copy.deepcopy(
+        dict(params["params"] if wrapped else params))
+
+    tree["embedding"]["embedding"] = _set_leaf(
+        tree["embedding"]["embedding"],
+        _to_np(sd["model.embed_tokens.weight"]))
+    tree["final_norm"]["scale"] = _set_leaf(
+        tree["final_norm"]["scale"], _to_np(sd["model.norm.weight"]))
+    if "lm_head" in tree:
+        head = _to_np(sd["lm_head.weight"]).T
+        tree["lm_head"]["kernel"] = _set_leaf(
+            tree["lm_head"]["kernel"], head)
+    elif "lm_head.weight" in sd:
+        # torch state_dict() lists the tied head under BOTH names when
+        # tie_word_embeddings=True — only a head that really differs
+        # from the embedding is an untied checkpoint
+        if not np.array_equal(_to_np(sd["lm_head.weight"]),
+                              _to_np(sd["model.embed_tokens.weight"])):
+            raise ValueError(
+                "checkpoint has an untied lm_head but the model ties "
+                "embeddings — build it with tie_embeddings=False")
+
+    n_ckpt = sum(1 for k in sd if k.endswith(".input_layernorm.weight"))
+    _write_layers(
+        tree["transformer"], n_ckpt,
+        lambda i: _llama_layer_values(sd, i, num_heads, hk, qkv_grouped))
     return {"params": tree} if wrapped else tree

@@ -62,6 +62,14 @@ class TransformerConfig:
     hidden_dropout: float = 0.0
     attention_dropout: float = 0.0
     activation: str = "gelu"
+    # biases on every linear (qkv/out/mlp) — Megatron's add_bias_linear;
+    # False for the Llama recipe
+    add_bias_linear: bool = True
+    # gated-linear-unit MLP (SwiGLU when activation="silu"):
+    # act(x·W_gate) * (x·W_up) -> RowParallel down-projection.  The gate
+    # and up projections are separate ColumnParallel weights sharded
+    # identically, so the elementwise product stays shard-local under TP.
+    gated_mlp: bool = False
     # parallel / compile behavior
     sequence_parallel: bool = False
     remat: bool = False
@@ -143,24 +151,52 @@ def _norm(cfg: TransformerConfig, name: str):
     return _Norm(name=name)
 
 
+def _cache_attention(q, keys, values, idx, scale):
+    """Decode-step attention of ``q`` (b, s, h, d) over the KV cache
+    (b, S, hk, d): GQA grouped dot, fp32 softmax, positions ``> idx+i``
+    masked.  Memory-bound (s is the decode chunk, usually 1) — plain
+    XLA is the right tool; the flash kernel is for the training path.
+    """
+    b, s, h, d = q.shape
+    S, hk = keys.shape[1], keys.shape[2]
+    rep = h // hk
+    qg = q.reshape(b, s, hk, rep, d).astype(jnp.float32)
+    scores = jnp.einsum(
+        "bsgrd,bkgd->bsgrk", qg, keys.astype(jnp.float32)) * scale
+    pos_q = idx + jnp.arange(s)
+    visible = jnp.arange(S)[None, :] <= pos_q[:, None]       # (s, S)
+    scores = jnp.where(visible[None, :, None, None, :], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bsgrk,bkgd->bsgrd", p, values.astype(jnp.float32))
+    return o.reshape(b, s, h, d).astype(q.dtype)
+
+
 class ParallelAttention(nn.Module):
     """TP attention block: ColumnParallel qkv → RoPE → flash → RowParallel.
 
     Head-sharded over the ``tensor`` axis (qkv ColumnParallel shards the
     head dim product; out-proj RowParallel reduces), the reference's
     layer recipe (SURVEY.md §3.4 steps 1-5).
+
+    ``decode=True`` switches to incremental decoding: k/v are appended
+    to a ``cache`` collection (``cached_key``/``cached_value`` of shape
+    ``(b, max_seq_len, kv_heads, d)`` + ``cache_index``) and q attends
+    over the cached prefix, with RoPE applied at the absolute cache
+    position.  The cache stores kv *heads* (GQA: ``kv_heads`` can be
+    far fewer than ``num_heads`` — the cache shrinks with it).
     """
 
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, mask_bias=None, deterministic: bool = True):
+    def __call__(self, x, *, mask_bias=None, deterministic: bool = True,
+                 decode: bool = False):
         cfg = self.cfg
         b, s, _ = x.shape
         h, hk, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
         qkv_features = (h + 2 * hk) * d
         qkv = ColumnParallelLinear(
-            features=qkv_features, use_bias=True,
+            features=qkv_features, use_bias=cfg.add_bias_linear,
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name="qkv_proj")(x)
@@ -182,29 +218,66 @@ class ParallelAttention(nn.Module):
             q = qkv[..., : h * d].reshape(b, s, h, d)
             k = qkv[..., h * d: (h + hk) * d].reshape(b, s, hk, d)
             v = qkv[..., (h + hk) * d:].reshape(b, s, hk, d)
-        if cfg.position_embedding == "rope":
-            rot = int(cfg.rotary_pct * d) // 2 * 2
-            cos, sin = rope_cos_sin(s, rot, base=cfg.rope_base)
-            q = fused_rope(q, cos, sin)
-            k = fused_rope(k, cos, sin)
-        # attention-prob dropout runs INSIDE the flash kernel (counter-
-        # hash mask, regenerated in the backward kernels) — the dropout
-        # path no longer bypasses the Pallas attention
-        drop = cfg.attention_dropout if (
-            cfg.attention_dropout > 0.0 and not deterministic) else 0.0
-        o = fused_attention(
-            q, k, v, causal=cfg.causal, bias=mask_bias,
-            dropout_rate=drop,
-            dropout_rng=self.make_rng("dropout") if drop > 0.0 else None,
-            block_q=cfg.attention_block_q,
-            block_k=cfg.attention_block_k)
+        rot = int(cfg.rotary_pct * d) // 2 * 2
+        if decode:
+            if not cfg.causal:
+                raise ValueError(
+                    "decode=True requires a causal model (the cache "
+                    "attends over the generated prefix)")
+            if mask_bias is not None:
+                raise ValueError(
+                    "mask_bias is not supported with decode=True — the "
+                    "cache attention masks by absolute position only; "
+                    "bucket ragged prompts instead of padding them")
+            # contract: the caller must not advance the cache past
+            # max_seq_len — the index is traced, so it cannot be
+            # validated here; dynamic_update_slice would silently clamp.
+            # generate() enforces the bound statically.
+            S = cfg.max_seq_len
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (b, S, hk, d), k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (b, S, hk, d), v.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.array(0, jnp.int32))
+            idx = ci.value
+            if cfg.position_embedding == "rope":
+                cos, sin = rope_cos_sin(S, rot, base=cfg.rope_base)
+                cos = jax.lax.dynamic_slice_in_dim(cos, idx, s, 0)
+                sin = jax.lax.dynamic_slice_in_dim(sin, idx, s, 0)
+                q = fused_rope(q, cos, sin)
+                k = fused_rope(k, cos, sin)
+            keys = jax.lax.dynamic_update_slice_in_dim(
+                ck.value, k, idx, 1)
+            values = jax.lax.dynamic_update_slice_in_dim(
+                cv.value, v, idx, 1)
+            ck.value, cv.value = keys, values
+            ci.value = idx + s
+            o = _cache_attention(q, keys, values, idx, d ** -0.5)
+        else:
+            if cfg.position_embedding == "rope":
+                cos, sin = rope_cos_sin(s, rot, base=cfg.rope_base)
+                q = fused_rope(q, cos, sin)
+                k = fused_rope(k, cos, sin)
+            # attention-prob dropout runs INSIDE the flash kernel
+            # (counter-hash mask, regenerated in the backward kernels) —
+            # the dropout path no longer bypasses the Pallas attention
+            drop = cfg.attention_dropout if (
+                cfg.attention_dropout > 0.0 and not deterministic) else 0.0
+            o = fused_attention(
+                q, k, v, causal=cfg.causal, bias=mask_bias,
+                dropout_rate=drop,
+                dropout_rng=(self.make_rng("dropout") if drop > 0.0
+                             else None),
+                block_q=cfg.attention_block_q,
+                block_k=cfg.attention_block_k)
         # remat_policy="save_only:attn_out,attn_lse" saves the flash
         # kernel's own output/lse residuals — named inside the kernel's
         # fwd rule (ops/attention.py), not here: a second layer-level
         # tag with the same name would store the attention output twice
         o = o.reshape(b, s, h * d)
         return RowParallelLinear(
-            features=cfg.hidden_size, use_bias=True,
+            features=cfg.hidden_size, use_bias=cfg.add_bias_linear,
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name="out_proj")(o)
@@ -222,14 +295,25 @@ class ParallelMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
+        act = resolve_activation(cfg.activation, gelu_approximate=True)
         y = ColumnParallelLinear(
-            features=cfg.ffn_size, use_bias=True,
+            features=cfg.ffn_size, use_bias=cfg.add_bias_linear,
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name="dense_h_to_4h")(x)
-        y = resolve_activation(cfg.activation, gelu_approximate=True)(y)
+        if cfg.gated_mlp:
+            # SwiGLU-style GLU: gate and up projections sharded
+            # identically over the tensor axis, product shard-local
+            gate = ColumnParallelLinear(
+                features=cfg.ffn_size, use_bias=cfg.add_bias_linear,
+                sequence_parallel=cfg.sequence_parallel,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="dense_h_to_4h_gate")(x)
+            y = act(gate) * y
+        else:
+            y = act(y)
         return RowParallelLinear(
-            features=cfg.hidden_size, use_bias=True,
+            features=cfg.hidden_size, use_bias=cfg.add_bias_linear,
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name="dense_4h_to_h")(y)
@@ -241,13 +325,15 @@ class ParallelTransformerLayer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, mask_bias=None, deterministic: bool = True):
+    def __call__(self, x, *, mask_bias=None, deterministic: bool = True,
+                 decode: bool = False):
         cfg = self.cfg
         seq_spec = (TENSOR_AXIS if cfg.sequence_parallel else None)
         x = maybe_constrain(x, "data", seq_spec)
         a = _norm(cfg, "input_norm")(x)
         a = ParallelAttention(cfg, name="attention")(
-            a, mask_bias=mask_bias, deterministic=deterministic)
+            a, mask_bias=mask_bias, deterministic=deterministic,
+            decode=decode)
         if cfg.hidden_dropout > 0.0 and not deterministic:
             a = nn.Dropout(rate=cfg.hidden_dropout)(a, deterministic=False)
         x = x + a.astype(x.dtype)
@@ -264,11 +350,13 @@ class _ScanBlock(nn.Module):
 
     cfg: TransformerConfig
     deterministic: bool
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
         y = ParallelTransformerLayer(self.cfg, name="layer")(
-            x, mask_bias=mask_bias, deterministic=self.deterministic)
+            x, mask_bias=mask_bias, deterministic=self.deterministic,
+            decode=self.decode)
         return y, None
 
 
@@ -285,7 +373,8 @@ class ParallelTransformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, mask_bias=None, deterministic: bool = True):
+    def __call__(self, x, *, mask_bias=None, deterministic: bool = True,
+                 decode: bool = False):
         cfg = self.cfg
         if cfg.scan_layers:
             block_cls = _ScanBlock
@@ -295,13 +384,14 @@ class ParallelTransformer(nn.Module):
                     policy=_remat_policy(cfg.remat_policy))
             stack = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=nn.broadcast,
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: None},
             )
-            x, _ = stack(cfg, deterministic, name="layers")(x, mask_bias)
+            x, _ = stack(cfg, deterministic, decode,
+                         name="layers")(x, mask_bias)
         else:
             remat_cls = ParallelTransformerLayer
             if cfg.remat:
@@ -318,5 +408,6 @@ class ParallelTransformer(nn.Module):
                 layer_cls = (ParallelTransformerLayer if skip
                              else remat_cls)
                 x = layer_cls(cfg, name=f"layer_{i}")(
-                    x, mask_bias=mask_bias, deterministic=deterministic)
+                    x, mask_bias=mask_bias, deterministic=deterministic,
+                    decode=decode)
         return x

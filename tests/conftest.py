@@ -24,6 +24,38 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Build the optional _apex_C extension if it is missing (e.g. after an
+# environment reset wiped the in-place .so): the native tests are
+# skip-guarded on it, and a silently-skipped native suite defeats the
+# point of having one.  Failure is non-fatal — setup.py already treats
+# the extension as optional — but is reported once and remembered via a
+# sentinel so a toolchain-less machine doesn't re-pay the build attempt
+# (and re-hide its error) on every pytest run.
+try:
+    from apex_tpu import native as _native
+    _root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _sentinel = os.path.join(_root, "build", ".native_build_failed")
+    if not _native.HAVE_NATIVE and not os.path.exists(_sentinel):
+        import subprocess
+        import sys
+
+        _res = subprocess.run(
+            [sys.executable, "setup.py", "build_ext", "--inplace"],
+            cwd=_root, capture_output=True, text=True, timeout=120,
+            check=False)
+        import importlib
+
+        importlib.invalidate_caches()
+        importlib.reload(_native)     # re-attempts the _apex_C import
+        if not _native.HAVE_NATIVE:
+            os.makedirs(os.path.dirname(_sentinel), exist_ok=True)
+            with open(_sentinel, "w") as f:
+                f.write(_res.stdout[-2000:] + "\n" + _res.stderr[-2000:])
+            print(f"warning: _apex_C build failed — native tests will "
+                  f"skip; log: {_sentinel}")
+except Exception as _exc:                           # noqa: BLE001
+    print(f"warning: _apex_C auto-build errored: {_exc!r}")
+
 # A sitecustomize hook may have imported jax (registering a TPU plugin)
 # before this conftest ran, making the env var above a no-op.  Setting
 # the config directly still works as long as no backend has been used.

@@ -1,0 +1,167 @@
+"""KV-cache incremental decoding + generation.
+
+Correctness contract: the decode path (cache attention + RoPE/position
+offsets) must compute exactly the same function as the full forward —
+asserted per position — and greedy ``generate`` must reproduce the
+argmax chain of repeated full forwards.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models import (
+    GPTConfig,
+    GPTModel,
+    LlamaConfig,
+    LlamaModel,
+    generate,
+    init_cache,
+)
+
+
+def _decode_all(model, params, ids):
+    """Prefill 4 tokens, then decode the rest one at a time; return
+    logits for every position."""
+    b, s = ids.shape
+    cache = init_cache(model, b)
+    pre = 4
+    logits, vars_ = model.apply(
+        {**params, "cache": cache}, ids[:, :pre],
+        deterministic=True, decode=True, mutable=["cache"])
+    outs = [logits]
+    for t in range(pre, s):
+        step, vars_ = model.apply(
+            {**params, "cache": vars_["cache"]}, ids[:, t:t + 1],
+            deterministic=True, decode=True, mutable=["cache"])
+        outs.append(step)
+    return jnp.concatenate(outs, axis=1)
+
+
+CONFIGS = {
+    "gpt_learned": lambda scan: GPTConfig.tiny(
+        position_embedding="learned", scan_layers=scan),
+    "llama_gqa": lambda scan: LlamaConfig.tiny(scan_layers=scan),
+}
+
+
+class TestIncrementalDecode:
+    @pytest.mark.parametrize("name", sorted(CONFIGS))
+    @pytest.mark.parametrize("scan", [False, True])
+    def test_matches_full_forward(self, name, scan):
+        cfg = CONFIGS[name](scan)
+        model = (LlamaModel if name.startswith("llama") else GPTModel)(cfg)
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, size=(2, 12)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        params = {"params": params["params"]}
+        full = model.apply(params, ids, deterministic=True)
+        inc = _decode_all(model, params, ids)
+        np.testing.assert_allclose(
+            np.asarray(inc), np.asarray(full), atol=2e-5, rtol=2e-5)
+
+    def test_decode_requires_causal(self):
+        cfg = GPTConfig.tiny(causal=False)
+        model = GPTModel(cfg)
+        ids = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        with pytest.raises(ValueError, match="causal"):
+            model.apply(params, ids, deterministic=True, decode=True,
+                        mutable=["cache"])
+
+    def test_gqa_cache_stores_kv_heads_only(self):
+        cfg = LlamaConfig.tiny(scan_layers=False)
+        model = LlamaModel(cfg)
+        cache = init_cache(model, 2)
+        k = cache["transformer"]["layer_0"]["attention"]["cached_key"]
+        assert k.shape == (2, cfg.max_seq_len, cfg.kv_heads,
+                           cfg.head_dim)
+        assert cfg.kv_heads < cfg.num_heads
+
+
+class TestGenerate:
+    def test_greedy_matches_full_forward_chain(self):
+        cfg = GPTConfig.tiny(position_embedding="learned",
+                             scan_layers=True)
+        model = GPTModel(cfg)
+        prompt = jnp.asarray(np.random.default_rng(1).integers(
+            0, cfg.vocab_size, size=(2, 5)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)
+        n = 6
+        got = generate(model, params, prompt, max_new_tokens=n)
+        # reference: repeated full forwards + argmax
+        ids = prompt
+        for _ in range(n):
+            logits = model.apply(params, ids, deterministic=True)
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ids))
+
+    def test_sampling_shapes_and_eos(self):
+        cfg = LlamaConfig.tiny(scan_layers=True)
+        model = LlamaModel(cfg)
+        prompt = jnp.asarray([[3, 4, 5], [7, 8, 9]], jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)
+        out = generate(model, params, prompt, max_new_tokens=4,
+                       temperature=0.8, top_k=20,
+                       rng=jax.random.PRNGKey(2))
+        assert out.shape == (2, 7)
+        assert np.all(np.asarray(out[:, :3]) == np.asarray(prompt))
+        # eos latching: once eos appears, the tail is all eos
+        eos = int(np.asarray(out)[0, 3])
+        out2 = generate(model, params, prompt, max_new_tokens=5,
+                        temperature=0.8, top_k=20,
+                        rng=jax.random.PRNGKey(2), eos_id=eos)
+        arr = np.asarray(out2)[0]
+        after = arr[4:]
+        assert np.all(after == eos)
+
+    def test_overlong_generation_raises(self):
+        cfg = GPTConfig.tiny(position_embedding="learned")
+        model = GPTModel(cfg)
+        prompt = jnp.zeros((1, 10), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            generate(model, params, prompt,
+                     max_new_tokens=cfg.max_seq_len)
+
+    def test_eos_in_prompt_does_not_latch(self):
+        cfg = GPTConfig.tiny(position_embedding="learned",
+                             scan_layers=True)
+        model = GPTModel(cfg)
+        # pick an eos id the model provably never produces: generate
+        # plain first, choose an id absent from prompt-continuation,
+        # then put THAT id in the prompt and re-run with eos latching
+        params_probe = model.init(jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 3), jnp.int32))
+        plain = np.asarray(generate(
+            model, params_probe, jnp.asarray([[7, 3, 9]], jnp.int32),
+            max_new_tokens=4))
+        eos = next(t for t in range(cfg.vocab_size)
+                   if t not in plain[0, 3:])
+        prompt = jnp.asarray([[eos, 3, eos]], jnp.int32)
+        with_eos = np.asarray(generate(
+            model, params_probe, prompt, max_new_tokens=4, eos_id=eos))
+        ref = np.asarray(generate(
+            model, params_probe, prompt, max_new_tokens=4))
+        # continuations of THIS prompt may differ from the probe run,
+        # but unless the model itself emits eos (checked below), the
+        # eos-in-prompt must not force the output to eos
+        if not np.any(ref[0, 3:-1] == eos):
+            np.testing.assert_array_equal(with_eos, ref)
+        # unconditional: the FIRST produced token can never be forced
+        # to eos by a prompt-contained eos (latching starts only after
+        # a produced eos), so it must match the unlatched run exactly
+        assert with_eos[0, 3] == ref[0, 3], (
+            "prompt-contained eos forced the first produced token")
+
+    def test_sampling_without_rng_raises(self):
+        cfg = GPTConfig.tiny(position_embedding="learned")
+        model = GPTModel(cfg)
+        prompt = jnp.zeros((1, 3), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)
+        with pytest.raises(ValueError, match="rng"):
+            generate(model, params, prompt, max_new_tokens=2,
+                     temperature=1.0)

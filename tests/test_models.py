@@ -330,6 +330,116 @@ class TestTorchImport:
             load_torch_gpt2(params, tm.state_dict(),
                             num_heads=2)
 
+    @pytest.mark.parametrize("scan,kv_heads", [
+        (False, 2),      # GQA, unrolled
+        (True, 2),       # GQA, scanned
+        (False, 4),      # MHA degenerate case of the same path
+    ])
+    def test_llama_logits_match_torch(self, scan, kv_heads):
+        import torch
+        from transformers import LlamaConfig as HFLlamaConfig
+        from transformers import LlamaForCausalLM
+
+        from apex_tpu.models import LlamaConfig, LlamaModel
+        from apex_tpu.models.torch_import import load_torch_llama
+
+        torch.manual_seed(0)
+        hf_cfg = HFLlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=kv_heads, max_position_embeddings=32,
+            rope_theta=10000.0, attention_dropout=0.0,
+            tie_word_embeddings=False)
+        tm = LlamaForCausalLM(hf_cfg).eval()
+
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=64, ffn_hidden_size=96,
+            num_layers=2, num_heads=4, num_kv_heads=kv_heads,
+            max_seq_len=32, scan_layers=scan)
+        model = LlamaModel(cfg)
+        ids_np = np.random.default_rng(0).integers(
+            0, 128, size=(2, 16)).astype(np.int64)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.asarray(ids_np, jnp.int32))
+        params = load_torch_llama(params, tm.state_dict(),
+                                  num_heads=cfg.num_heads,
+                                  num_kv_heads=kv_heads)
+
+        with torch.no_grad():
+            want = tm(torch.from_numpy(ids_np)).logits.numpy()
+        got = np.asarray(model.apply(
+            params, jnp.asarray(ids_np, jnp.int32), deterministic=True),
+            np.float32)
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+    def test_llama_tied_checkpoint_imports(self):
+        """torch state_dict() lists the tied head under both names —
+        the importer must accept it into a tie_embeddings=True model."""
+        import torch
+        from transformers import LlamaConfig as HFLlamaConfig
+        from transformers import LlamaForCausalLM
+
+        from apex_tpu.models import LlamaConfig, LlamaModel
+        from apex_tpu.models.torch_import import load_torch_llama
+
+        torch.manual_seed(2)
+        tm = LlamaForCausalLM(HFLlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=2, max_position_embeddings=16,
+            tie_word_embeddings=True)).eval()
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=32, ffn_hidden_size=48,
+            num_layers=1, num_heads=2, max_seq_len=16,
+            tie_embeddings=True, scan_layers=False)
+        model = LlamaModel(cfg)
+        ids = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        params = load_torch_llama(params, tm.state_dict(),
+                                  num_heads=2)
+        import torch as _t
+        with _t.no_grad():
+            want = tm(_t.tensor([[1, 2, 3, 4]])).logits.numpy()
+        got = np.asarray(model.apply(params, ids, deterministic=True))
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+    def test_llama_flat_matches_grouped(self):
+        """The GQA grouped permutation is exactly the flat layout seen
+        through the model's grouped reshape: importing the same torch
+        checkpoint into a qkv_grouped=False model must give identical
+        logits."""
+        import torch
+        from transformers import LlamaConfig as HFLlamaConfig
+        from transformers import LlamaForCausalLM
+
+        from apex_tpu.models import LlamaConfig, LlamaModel
+        from apex_tpu.models.torch_import import load_torch_llama
+
+        torch.manual_seed(1)
+        tm = LlamaForCausalLM(HFLlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=1, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=16,
+            tie_word_embeddings=False)).eval()
+        ids = jnp.asarray(np.random.default_rng(1).integers(
+            0, 64, size=(1, 8)), jnp.int32)
+
+        outs = []
+        for grouped in (True, False):
+            cfg = LlamaConfig(
+                vocab_size=64, hidden_size=32, ffn_hidden_size=48,
+                num_layers=1, num_heads=4, num_kv_heads=2,
+                max_seq_len=16, qkv_grouped=grouped, scan_layers=False)
+            model = LlamaModel(cfg)
+            params = model.init(jax.random.PRNGKey(0), ids)
+            params = load_torch_llama(
+                params, tm.state_dict(), num_heads=4, num_kv_heads=2,
+                qkv_grouped=grouped)
+            outs.append(np.asarray(
+                model.apply(params, ids, deterministic=True)))
+        np.testing.assert_allclose(outs[0], outs[1], atol=1e-5,
+                                   rtol=1e-5)
+
     def test_registration_conflict_raises(self):
         import types
         from apex_tpu import amp
