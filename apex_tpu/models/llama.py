@@ -70,6 +70,21 @@ class LlamaConfig(GPTConfig):
         return cls(**kw)
 
     @classmethod
+    def mistral_7b(cls, **kw) -> "LlamaConfig":
+        """Mistral-7B: the llama recipe + GQA (8 kv heads) + 4096-token
+        sliding-window attention (the flash kernel's banded grid)."""
+        kw.setdefault("layernorm_eps", 1e-5)
+        kw.setdefault("vocab_size", 32000)
+        kw.setdefault("hidden_size", 4096)
+        kw.setdefault("num_layers", 32)
+        kw.setdefault("num_heads", 32)
+        kw.setdefault("num_kv_heads", 8)
+        kw.setdefault("ffn_hidden_size", 14336)
+        kw.setdefault("max_seq_len", 8192)
+        kw.setdefault("sliding_window", 4096)
+        return cls(**kw)
+
+    @classmethod
     def llama3_8b(cls, **kw) -> "LlamaConfig":
         """GQA sizing (8 kv heads), 128k vocab, rope theta 5e5."""
         kw.setdefault("layernorm_eps", 1e-5)

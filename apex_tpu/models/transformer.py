@@ -65,6 +65,11 @@ class TransformerConfig:
     # biases on every linear (qkv/out/mlp) — Megatron's add_bias_linear;
     # False for the Llama recipe
     add_bias_linear: bool = True
+    # sliding-window attention (Mistral-style; requires causal): each
+    # query attends to the last `sliding_window` positions only.  The
+    # flash kernel enumerates just the in-band tiles, so long-sequence
+    # attention cost scales with window/seq, not seq.
+    sliding_window: Optional[int] = None
     # gated-linear-unit MLP (SwiGLU when activation="silu"):
     # act(x·W_gate) * (x·W_up) -> RowParallel down-projection.  The gate
     # and up projections are separate ColumnParallel weights sharded
@@ -127,6 +132,14 @@ class TransformerConfig:
         if self.norm not in ("layernorm", "rmsnorm"):
             raise ValueError(
                 f"norm={self.norm!r} not in ('layernorm', 'rmsnorm')")
+        if self.sliding_window is not None:
+            if not self.causal:
+                raise ValueError(
+                    "sliding_window requires causal=True")
+            if self.sliding_window < 1:
+                raise ValueError(
+                    f"sliding_window must be >= 1, got "
+                    f"{self.sliding_window}")
 
 
 def _remat_policy(spec: str):
@@ -151,11 +164,12 @@ def _norm(cfg: TransformerConfig, name: str):
     return _Norm(name=name)
 
 
-def _cache_attention(q, keys, values, idx, scale):
+def _cache_attention(q, keys, values, idx, scale, window=None):
     """Decode-step attention of ``q`` (b, s, h, d) over the KV cache
     (b, S, hk, d): GQA grouped dot, fp32 softmax, positions ``> idx+i``
-    masked.  Memory-bound (s is the decode chunk, usually 1) — plain
-    XLA is the right tool; the flash kernel is for the training path.
+    (and, with ``window``, ``<= idx+i-window``) masked.  Memory-bound
+    (s is the decode chunk, usually 1) — plain XLA is the right tool;
+    the flash kernel is for the training path.
     """
     b, s, h, d = q.shape
     S, hk = keys.shape[1], keys.shape[2]
@@ -164,7 +178,10 @@ def _cache_attention(q, keys, values, idx, scale):
     scores = jnp.einsum(
         "bsgrd,bkgd->bsgrk", qg, keys.astype(jnp.float32)) * scale
     pos_q = idx + jnp.arange(s)
-    visible = jnp.arange(S)[None, :] <= pos_q[:, None]       # (s, S)
+    k_pos = jnp.arange(S)[None, :]
+    visible = k_pos <= pos_q[:, None]                        # (s, S)
+    if window is not None:
+        visible &= k_pos > pos_q[:, None] - window
     scores = jnp.where(visible[None, :, None, None, :], scores, -1e30)
     p = jax.nn.softmax(scores, axis=-1)
     o = jnp.einsum("bsgrk,bkgd->bsgrd", p, values.astype(jnp.float32))
@@ -253,7 +270,8 @@ class ParallelAttention(nn.Module):
                 cv.value, v, idx, 1)
             ck.value, cv.value = keys, values
             ci.value = idx + s
-            o = _cache_attention(q, keys, values, idx, d ** -0.5)
+            o = _cache_attention(q, keys, values, idx, d ** -0.5,
+                                 window=cfg.sliding_window)
         else:
             if cfg.position_embedding == "rope":
                 cos, sin = rope_cos_sin(s, rot, base=cfg.rope_base)
@@ -266,6 +284,7 @@ class ParallelAttention(nn.Module):
                 cfg.attention_dropout > 0.0 and not deterministic) else 0.0
             o = fused_attention(
                 q, k, v, causal=cfg.causal, bias=mask_bias,
+                window=cfg.sliding_window,
                 dropout_rate=drop,
                 dropout_rng=(self.make_rng("dropout") if drop > 0.0
                              else None),
@@ -394,7 +413,10 @@ class ParallelTransformer(nn.Module):
                          name="layers")(x, mask_bias)
         else:
             remat_cls = ParallelTransformerLayer
-            if cfg.remat:
+            # decode never remats (inference has no backward) — and the
+            # decode kwarg must not reach nn.remat, which would trace
+            # the Python bool into a concrete-less tracer
+            if cfg.remat and not decode:
                 remat_cls = nn.remat(
                     ParallelTransformerLayer, prevent_cse=False,
                     policy=_remat_policy(cfg.remat_policy))
@@ -407,7 +429,8 @@ class ParallelTransformer(nn.Module):
                         and i % cfg.remat_skip_every == 0)
                 layer_cls = (ParallelTransformerLayer if skip
                              else remat_cls)
+                kw = {"decode": True} if decode else {}
                 x = layer_cls(cfg, name=f"layer_{i}")(
                     x, mask_bias=mask_bias, deterministic=deterministic,
-                    decode=decode)
+                    **kw)
         return x

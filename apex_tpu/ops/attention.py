@@ -141,6 +141,7 @@ def mask_to_bias(masked):
 # --------------------------------------------------------------------- #
 def attention_reference(q, k, v, *, causal: bool = False,
                         scale: Optional[float] = None, bias=None,
+                        window: Optional[int] = None,
                         dropout_rate: float = 0.0,
                         dropout_seed=None):
     """Eager attention: softmax(q·kᵀ·scale + bias [causal]) · v.
@@ -148,10 +149,14 @@ def attention_reference(q, k, v, *, causal: bool = False,
     Shapes: q (b, sq, h, d); k/v (b, sk, hk, d) with h % hk == 0.
     Query rows with no visible key (causal with sq > sk) output zeros —
     the flash-attention convention, matched by the Pallas kernel.
+    ``window``: sliding-window (requires ``causal``) — each query sees
+    only the last ``window`` key positions, self included.
     ``dropout_rate`` drops attention probabilities post-softmax using
     the counter-hash mask (:func:`dropout_keep_mask`) — bit-identical
     to the Pallas kernels' in-tile dropout.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     b, sq, h, d = q.shape
     hk = k.shape[2]
     scale = (d ** -0.5) if scale is None else scale
@@ -168,6 +173,9 @@ def attention_reference(q, k, v, *, causal: bool = False,
         q_idx = jnp.arange(sq)[:, None]
         k_idx = jnp.arange(sk)[None, :]
         s = jnp.where(k_idx > q_idx + (sk - sq), _NEG_INF, s)
+        if window is not None:
+            s = jnp.where(k_idx <= q_idx + (sk - sq) - window,
+                          _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
     if causal or bias is not None:
         # dead positions (score pushed below the -inf sentinel) get
@@ -195,7 +203,7 @@ _LOG2E = 1.4426950408889634
 
 
 def _scores(q_ref, k_ref, kvb_ref, i, j, *, scale, causal, per_q, bq,
-            bk, sq, sk):
+            bk, sq, sk, window=None):
     """log2-domain scaled scores for one (q-block, kv-block) tile,
     TRANSPOSED — (bk, bq): kv positions on sublanes, q positions on
     lanes — computed as k(q·scale·log2e)ᵀ (+ biasᵀ·log2e) with causal
@@ -238,6 +246,11 @@ def _scores(q_ref, k_ref, kvb_ref, i, j, *, scale, causal, per_q, bq,
         k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
         q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
         s = jnp.where(k_pos > q_pos + (sk - sq), _NEG_INF, s)
+        if window is not None:
+            # sliding window: only the last `window` positions
+            # (self included) are visible — k > q_abs - window
+            s = jnp.where(k_pos <= q_pos + (sk - sq) - window,
+                          _NEG_INF, s)
     return s
 
 
@@ -270,7 +283,7 @@ def _tri_ji(t, nb):
     Row j holds ``nb - j`` tiles (i = j..nb-1), offset
     ``off(j) = j·nb - j(j-1)/2``."""
     a = 2 * nb + 1
-    tf = (a * a - 8 * t).astype(jnp.float32)
+    tf = jnp.abs(a * a - 8 * t).astype(jnp.float32)
     j = ((a - jnp.sqrt(tf)) * 0.5).astype(jnp.int32)
 
     def off(x):
@@ -280,6 +293,49 @@ def _tri_ji(t, nb):
     j = jnp.where(off(j + 1) <= t, j + 1, j)
     i = j + (t - off(j))
     return i, j
+
+
+# --------------------------------------------------------------------- #
+# banded (sliding-window causal) grid enumeration
+# --------------------------------------------------------------------- #
+# With a sliding window of W kv blocks behind the diagonal, the live
+# tiles form the band max(0, i - W) <= j <= i: a triangular head
+# (rows i <= W) followed by a uniform part (W + 1 tiles per row).
+# W = nb - 1 covers the whole triangle, making these a strict
+# generalization of the _tri_* enumerations (which they call for their
+# triangular pieces) — the causal kernels always run the band grid.
+
+def _band_tiles(nb: int, W: int) -> int:
+    """Live-tile count of the band grid."""
+    head = min(nb, W + 1)
+    return head * (head + 1) // 2 + max(0, nb - W - 1) * (W + 1)
+
+
+def _band_ij(t, W):
+    """Banded lower-wedge enumeration, j inner: t -> (i, j) with
+    max(0, i - W) <= j <= i.  ``W >= nb - 1`` degenerates to
+    :func:`_tri_ij`."""
+    i1, j1 = _tri_ij(t)                          # triangular head
+    head = (W + 1) * (W + 2) // 2
+    tq = t - head
+    i2 = (W + 1) + tq // (W + 1)                 # uniform tail
+    j2 = (i2 - W) + (tq % (W + 1))
+    tail = t >= head
+    return jnp.where(tail, i2, i1), jnp.where(tail, j2, j1)
+
+
+def _band_ji(t, W, nb):
+    """Banded upper-wedge enumeration, i inner: t -> (i, j) with
+    j <= i <= min(j + W, nb - 1): a uniform head (full-length kv rows
+    j <= nb-1-W, W + 1 tiles each) then a shrinking triangular tail."""
+    J0 = nb - 1 - W                              # last full-length row
+    headN = (J0 + 1) * (W + 1)
+    j1 = t // (W + 1)
+    i1 = j1 + (t % (W + 1))
+    it, jt = _tri_ji(t - headN, W)               # tail rows, len W-j'
+    tail = t >= headN
+    return (jnp.where(tail, J0 + 1 + it, i1),
+            jnp.where(tail, J0 + 1 + jt, j1))
 
 
 def _dead_rows_possible(causal, has_bias, sq, sk) -> bool:
@@ -309,7 +365,7 @@ def _zero_dead(s, p, causal, has_bias, sq, sk):
 
 
 def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
-                   sk_blocks, sq, sk, tri):
+                   sk_blocks, sq, sk, tri, window=None, W=None):
     n = 3
     q_ref, k_ref, v_ref = refs[:3]
     kvb_ref = refs[n] if has_bias else None
@@ -319,16 +375,19 @@ def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
     o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[n:]
     lane = pl.program_id(0)
     if tri:
-        # triangular grid: only live tiles are visited, no predicated
-        # body (the pl.when wrap alone measured ~+0.5 µs/tile)
-        i, j = _tri_ij(pl.program_id(1))
+        # banded grid: only live tiles are visited, no predicated
+        # body (the pl.when wrap alone measured ~+0.5 µs/tile);
+        # W = nb-1 (no window) is the full causal triangle
+        i, j = _band_ij(pl.program_id(1), W)
+        init_pred = j == jnp.maximum(i - W, 0)
         final_pred = j == i
     else:
         j = pl.program_id(2)
         i = pl.program_id(1)
+        init_pred = j == 0
         final_pred = j == sk_blocks - 1
 
-    @pl.when(j == 0)
+    @pl.when(init_pred)
     def _init():
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
@@ -337,7 +396,7 @@ def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
     def _step():
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
                     causal=causal, per_q=per_q, bq=bq, bk=bk, sq=sq,
-                    sk=sk)                         # (bk, bq)
+                    sk=sk, window=window)          # (bk, bq)
         m_prev = m_ref[:]                          # (1, bq) lane row
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
         p = _zero_dead(s, jnp.exp2(s - m_new), causal, has_bias,
@@ -367,6 +426,12 @@ def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
         # position <= last query position (+ rectangular offset)
         q_last = (i + 1) * bq - 1 + (sk - sq)
         block_live = jnp.logical_or(not causal, j * bk <= q_last)
+        if window is not None:
+            # window block skip: the block's newest key must reach the
+            # oldest query's window start
+            q_first = i * bq + (sk - sq)
+            block_live = jnp.logical_and(
+                block_live, (j + 1) * bk - 1 >= q_first - window + 1)
         pl.when(block_live)(_step)
 
     @pl.when(final_pred)
@@ -380,26 +445,30 @@ def _fa_fwd_kernel(*refs, scale, causal, has_bias, per_q, rate, bq, bk,
         lse_ref[0] = m_ref[:] + jnp.log2(l_safe)
 
 
-def _tri_maps(tri, swapped, nb):
+def _tri_maps(tri, swapped, nb, W=None):
     """(i_map, j_map): block-index extractors for the grid's trailing
-    axes — rectangular (b, i, j) / (b, j, i), or triangular (b, t)
-    with (i, j) recovered from t (see :func:`_tri_ij`)."""
+    axes — rectangular (b, i, j) / (b, j, i), or banded/triangular
+    (b, t) with (i, j) recovered from t (``W`` kv blocks behind the
+    diagonal; ``None``/``nb - 1`` = full triangle)."""
+    if W is None:
+        W = nb - 1
     if tri and swapped:
-        return ((lambda t: _tri_ji(t, nb)[0]),
-                (lambda t: _tri_ji(t, nb)[1]))
+        return ((lambda t: _band_ji(t, W, nb)[0]),
+                (lambda t: _band_ji(t, W, nb)[1]))
     if tri:
-        return (lambda t: _tri_ij(t)[0]), (lambda t: _tri_ij(t)[1])
+        return ((lambda t: _band_ij(t, W)[0]),
+                (lambda t: _band_ij(t, W)[1]))
     if swapped:
         return (lambda j, i: i), (lambda j, i: j)
     return (lambda i, j: i), (lambda i, j: j)
 
 
-def _qkv_specs(d, bq, bk, rep, tri=False, swapped=False, nb=0):
-    """BlockSpecs for q/k/v under grid (b*h, i, j) (or the triangular
+def _qkv_specs(d, bq, bk, rep, tri=False, swapped=False, nb=0, W=None):
+    """BlockSpecs for q/k/v under grid (b*h, i, j) (or the banded
     (b*h, t)).  GQA: `rep` consecutive q heads share one kv head — the
     kv BlockSpecs index b // rep, so kv is never materialized
     per-q-head in HBM."""
-    im, jm = _tri_maps(tri, swapped, nb)
+    im, jm = _tri_maps(tri, swapped, nb, W)
     return [
         pl.BlockSpec((1, bq, d), lambda b, *g: (b, im(*g), 0),
                      memory_space=pltpu.VMEM),
@@ -411,7 +480,7 @@ def _qkv_specs(d, bq, bk, rep, tri=False, swapped=False, nb=0):
 
 
 def _bias_spec(mode, nh, bq, bk, *, swapped: bool = False, tri=False,
-               nb=0):
+               nb=0, W=None):
     """BlockSpec for the normalized TRANSPOSED (B0*H0, sk, S0) bias
     (key dim on sublanes, matching the kernels' (bk, bq) score tiles).
 
@@ -422,7 +491,7 @@ def _bias_spec(mode, nh, bq, bk, *, swapped: bool = False, tri=False,
     (b, j, i)."""
     has_batch, has_head, per_q = mode
     h0 = nh if has_head else 1
-    im, jm = _tri_maps(tri, swapped, nb)
+    im, jm = _tri_maps(tri, swapped, nb, W)
 
     def lead(bb):
         batch = bb // nh if has_batch else 0
@@ -446,24 +515,33 @@ def _use_tri(causal, sq, sk, bq, bk) -> bool:
     return bool(causal) and sq == sk and bq == bk
 
 
-def _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode, rate,
-                rep, nh, bq, bk, interpret):
+def _band_w(window, tri, nb, bk):
+    """Window width in kv blocks behind the diagonal (band grid)."""
+    if not tri or window is None:
+        return nb - 1
+    return min(nb - 1, (window + bk - 2) // bk)
+
+
+def _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, window, bias_mode,
+                rate, rep, nh, bq, bk, interpret):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     tri = _use_tri(causal, sq, sk, bq, bk)
     nb = sq // bq
-    grid = (bh, nb * (nb + 1) // 2) if tri else (bh, nb, sk // bk)
-    im, jm = _tri_maps(tri, False, nb)
+    W = _band_w(window, tri, nb, bk)
+    grid = (bh, _band_tiles(nb, W)) if tri else (bh, nb, sk // bk)
+    im, jm = _tri_maps(tri, False, nb, W)
     has_bias = kvb is not None
     kernel = functools.partial(
         _fa_fwd_kernel, scale=scale, causal=causal, has_bias=has_bias,
         per_q=bool(bias_mode and bias_mode[2]), rate=rate,
-        bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq, sk=sk, tri=tri)
-    in_specs = _qkv_specs(d, bq, bk, rep, tri=tri, nb=nb)
+        bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq, sk=sk, tri=tri,
+        window=window, W=W)
+    in_specs = _qkv_specs(d, bq, bk, rep, tri=tri, nb=nb, W=W)
     args = [q3, k3, v3]
     if has_bias:
         in_specs.append(_bias_spec(bias_mode, nh, bq, bk, tri=tri,
-                                   nb=nb))
+                                   nb=nb, W=W))
         args.append(kvb)
     if rate > 0.0:
         in_specs.append(_SEED_SPEC)
@@ -498,7 +576,7 @@ def _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode, rate,
 # --------------------------------------------------------------------- #
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
                       *refs, scale, causal, has_bias, per_q, rate, bq,
-                      bk, sk_blocks, sq, sk, tri):
+                      bk, sk_blocks, sq, sk, tri, window=None, W=None):
     n = 0
     kvb_ref = refs[n] if has_bias else None
     n += 1 if has_bias else 0
@@ -507,14 +585,16 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
     do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs[n:]
     lane = pl.program_id(0)
     if tri:
-        i, j = _tri_ij(pl.program_id(1))
+        i, j = _band_ij(pl.program_id(1), W)
+        init_pred = j == jnp.maximum(i - W, 0)
         final_pred = j == i
     else:
         j = pl.program_id(2)
         i = pl.program_id(1)
+        init_pred = j == 0
         final_pred = j == sk_blocks - 1
 
-    @pl.when(j == 0)
+    @pl.when(init_pred)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -523,7 +603,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
         delta = delta_ref[0]                       # (1, bq)
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
                     causal=causal, per_q=per_q, bq=bq, bk=bk, sq=sq,
-                    sk=sk)                         # (bk, bq)
+                    sk=sk, window=window)          # (bk, bq)
         # dead rows have lse == -inf making exp2(s - lse) == 1 there;
         # _zero_dead restores exact zeros
         p = _zero_dead(s, jnp.exp2(s - lse), causal, has_bias,
@@ -553,6 +633,10 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
     else:
         q_last = (i + 1) * bq - 1 + (sk - sq)
         block_live = jnp.logical_or(not causal, j * bk <= q_last)
+        if window is not None:
+            q_first = i * bq + (sk - sq)
+            block_live = jnp.logical_and(
+                block_live, (j + 1) * bk - 1 >= q_first - window + 1)
         pl.when(block_live)(_step)
 
     @pl.when(final_pred)
@@ -564,7 +648,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref,
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
                        *refs, scale, causal, has_bias, per_q, rate, bq,
-                       bk, sq_blocks, sq, sk, tri):
+                       bk, sq_blocks, sq, sk, tri, window=None, W=None):
     n = 0
     kvb_ref = refs[n] if has_bias else None
     n += 1 if has_bias else 0
@@ -573,14 +657,16 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
     do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs[n:]
     lane = pl.program_id(0)
     if tri:
-        # upper-wedge enumeration: kv block j outer, q block i inner
-        # from the diagonal down (i = j..nb-1)
-        i, j = _tri_ji(pl.program_id(1), sq_blocks)
+        # banded upper-wedge enumeration: kv block j outer, q block i
+        # inner from the diagonal down (i = j..min(j+W, nb-1))
+        i, j = _band_ji(pl.program_id(1), W, sq_blocks)
         init_pred = i == j
+        last_pred = i == jnp.minimum(j + W, sq_blocks - 1)
     else:
         i = pl.program_id(2)      # q block (sequential axis)
         j = pl.program_id(1)      # kv block
         init_pred = i == 0
+        last_pred = i == sq_blocks - 1
 
     @pl.when(init_pred)
     def _init():
@@ -592,7 +678,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
         delta = delta_ref[0]                       # (1, bq)
         s = _scores(q_ref, k_ref, kvb_ref, i, j, scale=scale,
                     causal=causal, per_q=per_q, bq=bq, bk=bk, sq=sq,
-                    sk=sk)                         # (bk, bq)
+                    sk=sk, window=window)          # (bk, bq)
         p = _zero_dead(s, jnp.exp2(s - lse), causal, has_bias,
                        sq, sk)
         if rate > 0.0:
@@ -631,9 +717,13 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
     else:
         q_last = (i + 1) * bq - 1 + (sk - sq)
         block_live = jnp.logical_or(not causal, j * bk <= q_last)
+        if window is not None:
+            q_first = i * bq + (sk - sq)
+            block_live = jnp.logical_and(
+                block_live, (j + 1) * bk - 1 >= q_first - window + 1)
         pl.when(block_live)(_step)
 
-    @pl.when(i == sq_blocks - 1)
+    @pl.when(last_pred)
     def _final():
         dk_ref[0] = jnp.transpose(
             dk_acc[:] * (1.0 / _LOG2E)).astype(dk_ref.dtype)
@@ -641,7 +731,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref,
 
 
 def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
-                bias_mode, rate, rep, nh, bq, bk, interpret):
+                window, bias_mode, rate, rep, nh, bq, bk, interpret):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     has_bias = kvb is not None
@@ -651,16 +741,18 @@ def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
 
     tri = _use_tri(causal, sq, sk, bq, bk)
     nb = sq // bq
-    im, jm = _tri_maps(tri, False, nb)
+    W = _band_w(window, tri, nb, bk)
+    n_tiles = _band_tiles(nb, W)
+    im, jm = _tri_maps(tri, False, nb, W)
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, scale=scale, causal=causal, has_bias=has_bias,
         per_q=per_q, rate=rate, bq=bq, bk=bk, sk_blocks=sk // bk, sq=sq,
-        sk=sk, tri=tri)
-    in_specs = _qkv_specs(d, bq, bk, rep, tri=tri, nb=nb)
+        sk=sk, tri=tri, window=window, W=W)
+    in_specs = _qkv_specs(d, bq, bk, rep, tri=tri, nb=nb, W=W)
     args = [q3, k3, v3]
     if has_bias:
         in_specs.append(_bias_spec(bias_mode, nh, bq, bk, tri=tri,
-                                   nb=nb))
+                                   nb=nb, W=W))
         args.append(kvb)
     if rate > 0.0:
         in_specs.append(_SEED_SPEC)
@@ -675,7 +767,7 @@ def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
     ]
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh, nb * (nb + 1) // 2) if tri else (bh, nb, sk // bk),
+        grid=(bh, n_tiles) if tri else (bh, nb, sk // bk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, *g: (b, im(*g), 0),
                                memory_space=pltpu.VMEM),
@@ -687,14 +779,14 @@ def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
     dkv_kernel = functools.partial(
         _fa_bwd_dkv_kernel, scale=scale, causal=causal,
         has_bias=has_bias, per_q=per_q, rate=rate, bq=bq, bk=bk,
-        sq_blocks=sq // bq, sq=sq, sk=sk, tri=tri)
+        sq_blocks=sq // bq, sq=sq, sk=sk, tri=tri, window=window, W=W)
     # dk/dv are computed per *q* head (grid axis 0 = b*h) so each output
     # block is owned by one grid lane; for GQA the rep-sized head groups
     # are summed afterwards (cheap, fp32) instead of making the kernel
     # revisit shared kv output blocks.  NB grid order (b, j, i) — or
     # the triangular (b, t) upper-wedge enumeration: the index maps
     # permute accordingly.
-    im2, jm2 = _tri_maps(tri, True, nb)
+    im2, jm2 = _tri_maps(tri, True, nb, W)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, *g: (b, im2(*g), 0),
                      memory_space=pltpu.VMEM),
@@ -706,7 +798,7 @@ def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
     args = [q3, k3, v3]
     if has_bias:
         in_specs.append(_bias_spec(bias_mode, nh, bq, bk, swapped=True,
-                                   tri=tri, nb=nb))
+                                   tri=tri, nb=nb, W=W))
         args.append(kvb)
     if rate > 0.0:
         in_specs.append(_SEED_SPEC)
@@ -721,7 +813,7 @@ def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
     ]
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, nb * (nb + 1) // 2) if tri else (bh, sk // bk, nb),
+        grid=(bh, n_tiles) if tri else (bh, sk // bk, nb),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, *g: (b, jm2(*g), 0),
@@ -753,17 +845,17 @@ def _run_fa_bwd(q3, k3, v3, kvb, seed, o3, lse, do3, scale, causal,
 # custom VJP over (b*h, s, d) arrays
 # --------------------------------------------------------------------- #
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13))
-def _fa_pallas(q3, k3, v3, kvb, seed, scale, causal, bias_mode, rate,
-               rep, nh, bq, bk, interpret):
-    o, _ = _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode,
-                       rate, rep, nh, bq, bk, interpret)
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14))
+def _fa_pallas(q3, k3, v3, kvb, seed, scale, causal, window, bias_mode,
+               rate, rep, nh, bq, bk, interpret):
+    o, _ = _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, window,
+                       bias_mode, rate, rep, nh, bq, bk, interpret)
     return o
 
 
-def _fa_pallas_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode,
-                   rate, rep, nh, bq, bk, interpret):
-    o, lse = _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal,
+def _fa_pallas_fwd(q3, k3, v3, kvb, seed, scale, causal, window,
+                   bias_mode, rate, rep, nh, bq, bk, interpret):
+    o, lse = _run_fa_fwd(q3, k3, v3, kvb, seed, scale, causal, window,
                          bias_mode, rate, rep, nh, bq, bk, interpret)
     # named so a remat policy can save the kernel's residuals and skip
     # re-running the forward kernel in the backward pass entirely
@@ -777,12 +869,12 @@ def _fa_pallas_fwd(q3, k3, v3, kvb, seed, scale, causal, bias_mode,
     return o, (q3, k3, v3, kvb, seed, o, lse)
 
 
-def _fa_pallas_bwd(scale, causal, bias_mode, rate, rep, nh, bq, bk,
-                   interpret, res, do):
+def _fa_pallas_bwd(scale, causal, window, bias_mode, rate, rep, nh, bq,
+                   bk, interpret, res, do):
     q3, k3, v3, kvb, seed, o, lse = res
     dq, dk, dv = _run_fa_bwd(q3, k3, v3, kvb, seed, o, lse, do, scale,
-                             causal, bias_mode, rate, rep, nh, bq, bk,
-                             interpret)
+                             causal, window, bias_mode, rate, rep, nh,
+                             bq, bk, interpret)
     # the bias is treated as a constant (padding masks / ALiBi slopes);
     # learned biases must pass bias_requires_grad=True at the API level,
     # which routes to the differentiable XLA composition
@@ -856,6 +948,7 @@ def fused_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     bias=None,
                     bias_requires_grad: bool = False,
+                    window: Optional[int] = None,
                     dropout_rate: float = 0.0,
                     dropout_rng=None,
                     block_q: Optional[int] = None,
@@ -865,6 +958,14 @@ def fused_attention(q, k, v, *, causal: bool = False,
 
     Drop-in for the reference's ``SelfMultiheadAttn`` core /
     ``fmha`` (SURVEY.md §2.7).  GQA/MQA supported via fewer kv heads.
+
+    ``window``: sliding-window attention (Mistral/Gemma-style; requires
+    ``causal``) — each query attends only to the last ``window``
+    positions, self included.  On the causal self-attention hot path
+    the kernels enumerate ONLY the tiles inside the band (the same
+    linearized-live-tile trick as the causal triangle), so compute AND
+    time drop to ~``window/seq`` of full attention rather than just
+    masking — beyond-reference: the reference's fmha has no windowing.
 
     ``bias``: any additive bias broadcastable as ``(b|1, h|1, sq|1,
     sk)`` rides the Pallas kernel — key-padding rows from
@@ -887,6 +988,15 @@ def fused_attention(q, k, v, *, causal: bool = False,
     if h % hk:
         raise ValueError(
             f"num_kv_heads ({hk}) must divide num_heads ({h})")
+    if window is not None:
+        window = int(window)
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if not causal:
+            raise ValueError(
+                "sliding-window attention requires causal=True")
+        if window >= sk:
+            window = None              # window covers everything
     scale = (d ** -0.5) if scale is None else float(scale)
     # seq-aware default tiles: 512 short (fastest end-to-end at s=512,
     # BASELINE.md round-2 sweep), 1024 from 16k (21% faster fwd+bwd
@@ -927,13 +1037,13 @@ def fused_attention(q, k, v, *, causal: bool = False,
         seed_val = seed[0] if seed is not None else 0
         return attention_reference(
             q, k, v, causal=causal, scale=scale, bias=bias,
-            dropout_rate=rate, dropout_seed=seed_val)
+            window=window, dropout_rate=rate, dropout_seed=seed_val)
     interpret = impl == "pallas_interpret"
     # (b, s, h, d) -> (b*h, s, d); GQA kv stays at (b*hk, s, d) — the
     # kernels' kv BlockSpecs map rep consecutive q heads to one kv head
     q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     k3 = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
     v3 = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
-    o3 = _fa_pallas(q3, k3, v3, kvb, seed, scale, bool(causal),
+    o3 = _fa_pallas(q3, k3, v3, kvb, seed, scale, bool(causal), window,
                     bias_mode, rate, h // hk, h, bq, bk, interpret)
     return o3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

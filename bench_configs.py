@@ -660,23 +660,27 @@ def bench_long_context():
         # touch jax here — the child must be the only process holding
         # the chip
         rows = {}
-        for s in (8192, 16384, 32768):
+        # the (32768, 4096) row is Mistral-style sliding-window: the
+        # banded kernel grid pays only window/seq of full attention
+        for s, w in ((8192, 0), (16384, 0), (32768, 0), (32768, 4096)):
             env = dict(os.environ)
             env["BENCH_LC_SINGLE"] = "1"
             env["BENCH_SEQ"] = str(s)
+            env["BENCH_WINDOW"] = str(w)
+            key = f"{s}w{w}" if w else str(s)
             try:
                 proc = subprocess.run(
                     [sys.executable, __file__, "long_context"], env=env,
                     capture_output=True, text=True, timeout=1500)
             except subprocess.TimeoutExpired:
                 # record and keep the rows already measured
-                rows[str(s)] = {"error": "timeout after 1500s"}
+                rows[key] = {"error": "timeout after 1500s"}
                 continue
             lines = [l for l in proc.stdout.splitlines()
                      if l.startswith("{")]
-            rows[str(s)] = (json.loads(lines[-1]) if lines and
-                            proc.returncode == 0 else
-                            {"error": (proc.stderr or "?")[-800:]})
+            rows[key] = (json.loads(lines[-1]) if lines and
+                         proc.returncode == 0 else
+                         {"error": (proc.stderr or "?")[-800:]})
         out8 = dict(rows.get("8192") or {})
         out8.pop("metric", None)
         _emit({
@@ -700,10 +704,11 @@ def _long_context_single():
 
     b = int(os.environ.get("BENCH_BATCH", "1"))
     s = int(os.environ.get("BENCH_SEQ", "8192"))
+    w = int(os.environ.get("BENCH_WINDOW", "0")) or None
     cfg = GPTConfig(
         vocab_size=32768, hidden_size=1024, num_layers=12,
         num_heads=16, max_seq_len=s, dtype=jnp.bfloat16, remat=True,
-        scan_layers=False,
+        scan_layers=False, sliding_window=w,
         # single chip: no TP to profit from the grouped qkv layout, and
         # its strided-slice temps (2x-padded at d=64) cost real HBM at
         # 16k-32k tokens
@@ -741,9 +746,13 @@ def _long_context_single():
     # contention, not "this kernel class can't reach 197 TFLOP/s"
     # (round-3 verdict weak #4).  At 8k attention is a minor fraction
     # of the flops, so the chip-peak bound stays authoritative there.
+    # the windowed kernel's own measured ceiling is ~70 TFLOP/s on
+    # useful (in-band) flops — band-edge tiles under-fill the row
+    # pipeline relative to the full triangle's 93 (tools/attn_bench.py)
     out = _measure(state, step, (inputs, labels), b,
-                   {"batch": b, "seq": s},
-                   measured_tflops=93.0 if s >= 16384 else None)
+                   {"batch": b, "seq": s, "window": w},
+                   measured_tflops=(70.0 if w else 93.0)
+                   if s >= 16384 else None)
     out["tokens_per_sec"] = round(out["value"] * s, 1)
 
     if s == 8192:
@@ -770,7 +779,8 @@ def _long_context_single():
             except Exception as e:                 # composition may not
                 mems[impl] = f"uncompilable: {type(e).__name__}"  # fit
         out["attn_32k_temp_bytes"] = mems
-    out["metric"] = f"gpt_long_context_{s//1024}k_O2_samples_per_sec_per_chip"
+    tag = f"{s//1024}k" + (f"_swa{w//1024}k" if w else "")
+    out["metric"] = f"gpt_long_context_{tag}_O2_samples_per_sec_per_chip"
     _emit(out)
 
 

@@ -43,6 +43,10 @@ CONFIGS = {
     "gpt_learned": lambda scan: GPTConfig.tiny(
         position_embedding="learned", scan_layers=scan),
     "llama_gqa": lambda scan: LlamaConfig.tiny(scan_layers=scan),
+    # window=5 < the 12-token test sequence: decode must reproduce the
+    # banded training attention across the window boundary
+    "llama_swa": lambda scan: LlamaConfig.tiny(
+        sliding_window=5, scan_layers=scan),
 }
 
 

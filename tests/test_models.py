@@ -372,6 +372,44 @@ class TestTorchImport:
             np.float32)
         np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
 
+    def test_mistral_logits_match_torch(self):
+        """Mistral = llama recipe + sliding-window attention: the same
+        importer maps MistralForCausalLM (identical key names), and
+        logits must agree across the window boundary."""
+        import torch
+        from transformers import MistralConfig as HFMistralConfig
+        from transformers import MistralForCausalLM
+
+        from apex_tpu.models import LlamaConfig, LlamaModel
+        from apex_tpu.models.torch_import import load_torch_llama
+
+        torch.manual_seed(3)
+        tm = MistralForCausalLM(HFMistralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32,
+            sliding_window=4, attention_dropout=0.0,
+            tie_word_embeddings=False,
+            attn_implementation="eager")).eval()
+
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=64, ffn_hidden_size=96,
+            num_layers=2, num_heads=4, num_kv_heads=2,
+            max_seq_len=32, sliding_window=4, scan_layers=False)
+        model = LlamaModel(cfg)
+        ids_np = np.random.default_rng(3).integers(
+            0, 128, size=(2, 16)).astype(np.int64)   # 16 >> window 4
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.asarray(ids_np, jnp.int32))
+        params = load_torch_llama(params, tm.state_dict(),
+                                  num_heads=4, num_kv_heads=2)
+        with torch.no_grad():
+            want = tm(torch.from_numpy(ids_np)).logits.numpy()
+        got = np.asarray(model.apply(
+            params, jnp.asarray(ids_np, jnp.int32), deterministic=True),
+            np.float32)
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
     def test_llama_tied_checkpoint_imports(self):
         """torch state_dict() lists the tied head under both names —
         the importer must accept it into a tie_embeddings=True model."""

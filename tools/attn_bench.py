@@ -68,11 +68,12 @@ def measure(fn, args, iters, overhead, windows=3):
 
 
 def main():
-    kw = dict(s=32768, d=64, h=8, b=1, iters=8)
+    kw = dict(s=32768, d=64, h=8, b=1, iters=8, window=0)
     for a in sys.argv[1:]:
         k, v = a.split("=")
         kw[k] = int(v)
     s, d, h, b, iters = (kw[k] for k in ("s", "d", "h", "b", "iters"))
+    window = kw["window"] or None
 
     from apex_tpu.ops.attention import fused_attention
 
@@ -84,12 +85,12 @@ def main():
                           jnp.bfloat16)
 
     def fwd(q, k, v):
-        return fused_attention(q, k, v, causal=True,
+        return fused_attention(q, k, v, causal=True, window=window,
                                implementation="pallas")
 
     def fwd_bwd(q, k, v):
         def loss(q, k, v):
-            o = fused_attention(q, k, v, causal=True,
+            o = fused_attention(q, k, v, causal=True, window=window,
                                 implementation="pallas")
             return jnp.sum(o.astype(jnp.float32) ** 2)
 
@@ -98,9 +99,13 @@ def main():
     overhead = _overhead()
     dt_f = measure(fwd, (q, k, v), iters, overhead)
     dt_fb = measure(fwd_bwd, (q, k, v), iters, overhead)
-    unit = 2 * b * h * s * s * d * 0.5  # one tile-matmul's flops
+    # useful (visible) softmax positions: causal triangle, or the band
+    # (window > s executes full attention — clamp so flops stay honest)
+    w = min(window or s, s)
+    pairs = (w - 1) * w / 2 + (s - w + 1) * w     # sum_q min(q+1, w)
+    unit = 2 * b * h * pairs * d                  # one tile-matmul
     print(json.dumps({
-        "b": b, "s": s, "h": h, "d": d,
+        "b": b, "s": s, "h": h, "d": d, "window": window,
         "call_overhead_ms": round(overhead * 1e3, 1),
         "fwd_ms": round(dt_f * 1e3, 2),
         "fwd_tflops": round(2 * unit / dt_f / 1e12, 2),
