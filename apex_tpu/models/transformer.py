@@ -164,12 +164,17 @@ def _norm(cfg: TransformerConfig, name: str):
     return _Norm(name=name)
 
 
-def _cache_attention(q, keys, values, idx, scale, window=None):
+def _cache_attention(q, keys, values, idx, scale, window=None,
+                     key_positions=None):
     """Decode-step attention of ``q`` (b, s, h, d) over the KV cache
     (b, S, hk, d): GQA grouped dot, fp32 softmax, positions ``> idx+i``
     (and, with ``window``, ``<= idx+i-window``) masked.  Memory-bound
     (s is the decode chunk, usually 1) — plain XLA is the right tool;
     the flash kernel is for the training path.
+
+    ``key_positions``: per-slot absolute positions (rolling ring-buffer
+    cache; -1 marks an empty slot).  Default: slot index IS the
+    position (dense cache).
     """
     b, s, h, d = q.shape
     S, hk = keys.shape[1], keys.shape[2]
@@ -178,8 +183,9 @@ def _cache_attention(q, keys, values, idx, scale, window=None):
     scores = jnp.einsum(
         "bsgrd,bkgd->bsgrk", qg, keys.astype(jnp.float32)) * scale
     pos_q = idx + jnp.arange(s)
-    k_pos = jnp.arange(S)[None, :]
-    visible = k_pos <= pos_q[:, None]                        # (s, S)
+    k_pos = (jnp.arange(S) if key_positions is None
+             else key_positions)[None, :]
+    visible = (k_pos >= 0) & (k_pos <= pos_q[:, None])       # (s, S)
     if window is not None:
         visible &= k_pos > pos_q[:, None] - window
     scores = jnp.where(visible[None, :, None, None, :], scores, -1e30)
@@ -196,11 +202,17 @@ class ParallelAttention(nn.Module):
     layer recipe (SURVEY.md §3.4 steps 1-5).
 
     ``decode=True`` switches to incremental decoding: k/v are appended
-    to a ``cache`` collection (``cached_key``/``cached_value`` of shape
-    ``(b, max_seq_len, kv_heads, d)`` + ``cache_index``) and q attends
-    over the cached prefix, with RoPE applied at the absolute cache
-    position.  The cache stores kv *heads* (GQA: ``kv_heads`` can be
-    far fewer than ``num_heads`` — the cache shrinks with it).
+    to a ``cache`` collection (``cached_key``/``cached_value`` +
+    ``cache_index``) and q attends over the cached prefix, with RoPE
+    applied at the absolute cache position.  The cache stores kv
+    *heads* (GQA: ``kv_heads`` can be far fewer than ``num_heads`` —
+    the cache shrinks with it) and is ``(b, max_seq_len, kv_heads,
+    d)`` — except with ``sliding_window``, where it is a
+    window-sized RING BUFFER ``(b, window, kv_heads, d)`` plus a
+    ``slot_positions`` leaf (position+1 per slot; 0 = empty), so
+    decode memory scales with the window, not ``max_seq_len``.  A
+    multi-token decode chunk must be the FIRST call (prefill); decode
+    one token at a time afterwards.
     """
 
     cfg: TransformerConfig
@@ -251,12 +263,26 @@ class ParallelAttention(nn.Module):
             # validated here; dynamic_update_slice would silently clamp.
             # generate() enforces the bound statically.
             S = cfg.max_seq_len
+            # rolling ring-buffer cache (Mistral design): with a
+            # sliding window only the last `window` keys are ever
+            # visible, so the cache holds exactly that many slots —
+            # decode memory scales with window, not max_seq_len
+            Wc = (cfg.sliding_window
+                  if cfg.sliding_window and cfg.sliding_window < S
+                  else None)
+            Sc = Wc or S
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, S, hk, d), k.dtype)
+                               (b, Sc, hk, d), k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, S, hk, d), v.dtype)
+                               (b, Sc, hk, d), v.dtype)
             ci = self.variable("cache", "cache_index",
                                lambda: jnp.array(0, jnp.int32))
+            if Wc is not None:
+                # slot_positions stores position+1 (0 = empty slot):
+                # the all-zeros encoding keeps init_cache's
+                # zeros-from-shape invariant valid for every cache leaf
+                cp = self.variable("cache", "slot_positions",
+                                   jnp.zeros, (Wc,), jnp.int32)
             idx = ci.value
             if cfg.position_embedding == "rope":
                 cos, sin = rope_cos_sin(S, rot, base=cfg.rope_base)
@@ -264,14 +290,44 @@ class ParallelAttention(nn.Module):
                 sin = jax.lax.dynamic_slice_in_dim(sin, idx, s, 0)
                 q = fused_rope(q, cos, sin)
                 k = fused_rope(k, cos, sin)
-            keys = jax.lax.dynamic_update_slice_in_dim(
-                ck.value, k, idx, 1)
-            values = jax.lax.dynamic_update_slice_in_dim(
-                cv.value, v, idx, 1)
-            ck.value, cv.value = keys, values
+            scale = d ** -0.5
+            if Wc is None:
+                keys = jax.lax.dynamic_update_slice_in_dim(
+                    ck.value, k, idx, 1)
+                values = jax.lax.dynamic_update_slice_in_dim(
+                    cv.value, v, idx, 1)
+                ck.value, cv.value = keys, values
+                o = _cache_attention(q, keys, values, idx, scale,
+                                     window=cfg.sliding_window)
+            elif s == 1:
+                # steady decode: one slot write, attend over the ring
+                slot = idx % Wc
+                keys = jax.lax.dynamic_update_slice(
+                    ck.value, k, (0, slot, 0, 0))
+                values = jax.lax.dynamic_update_slice(
+                    cv.value, v, (0, slot, 0, 0))
+                pos = jax.lax.dynamic_update_slice(
+                    cp.value, idx[None] + 1, (slot,))
+                ck.value, cv.value, cp.value = keys, values, pos
+                o = _cache_attention(q, keys, values, idx, scale,
+                                     window=Wc,
+                                     key_positions=pos - 1)
+            else:
+                # multi-token chunk = PREFILL (contract: must be the
+                # first call — a mid-stream chunk would need ring
+                # entries older than the chunk, which in-chunk writes
+                # may already have evicted).  Attention runs directly
+                # on the chunk (banded), then the last Wc keys enter
+                # the ring.
+                o = fused_attention(q, k, v, causal=True,
+                                    scale=scale, window=Wc)
+                tail = min(s, Wc)
+                positions = idx + s - tail + jnp.arange(tail)
+                slots = positions % Wc
+                ck.value = ck.value.at[:, slots].set(k[:, -tail:])
+                cv.value = cv.value.at[:, slots].set(v[:, -tail:])
+                cp.value = cp.value.at[slots].set(positions + 1)
             ci.value = idx + s
-            o = _cache_attention(q, keys, values, idx, d ** -0.5,
-                                 window=cfg.sliding_window)
         else:
             if cfg.position_embedding == "rope":
                 cos, sin = rope_cos_sin(s, rot, base=cfg.rope_base)

@@ -120,3 +120,18 @@ class TestTransformerTPExample:
              "--batch-size", "3", "--seq-len", "32"])
         assert r.returncode != 0
         assert "multiple of the microbatch" in (r.stderr + r.stdout)
+
+
+class TestLlamaGenerateExample:
+    def test_greedy_matches_torch(self):
+        r = _run_example("examples/llama_generate.py", [])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "token-identical to torch" in r.stdout
+
+    def test_windowed_sampling(self):
+        r = _run_example(
+            "examples/llama_generate.py",
+            ["--window", "8", "--temperature", "0.8",
+             "--max-new-tokens", "6"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert r.stdout.count("cont:") == 2

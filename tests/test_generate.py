@@ -83,6 +83,74 @@ class TestIncrementalDecode:
                            cfg.head_dim)
         assert cfg.kv_heads < cfg.num_heads
 
+    def test_sliding_window_cache_is_window_sized(self):
+        """Rolling ring-buffer cache: with a sliding window the cache
+        holds `window` slots, not max_seq_len — decode memory scales
+        with the window (Mistral design)."""
+        cfg = LlamaConfig.tiny(sliding_window=5, scan_layers=False)
+        model = LlamaModel(cfg)
+        cache = init_cache(model, 2)
+        att = cache["transformer"]["layer_0"]["attention"]
+        assert att["cached_key"].shape == (2, 5, cfg.kv_heads,
+                                           cfg.head_dim)
+        assert att["slot_positions"].shape == (5,)
+        assert cfg.max_seq_len > 5
+
+    def test_rolling_cache_short_prefill(self):
+        """Regression: prefill SHORTER than window-1 leaves empty ring
+        slots; their position encoding (0 = empty) must keep them
+        invisible — a zeros-initialized cache once made empty slots
+        claim position 0, letting stale zero keys into the softmax
+        (max-abs logits error 0.76)."""
+        cfg = LlamaConfig.tiny(sliding_window=5, scan_layers=False)
+        model = LlamaModel(cfg)
+        ids = jnp.asarray(np.random.default_rng(8).integers(
+            0, cfg.vocab_size, size=(2, 10)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        params = {"params": params["params"]}
+        full = model.apply(params, ids, deterministic=True)
+        for pre in (1, 2, 3):
+            cache = init_cache(model, 2)
+            logits, vars_ = model.apply(
+                {**params, "cache": cache}, ids[:, :pre],
+                deterministic=True, decode=True, mutable=["cache"])
+            outs = [logits]
+            for t in range(pre, 10):
+                step, vars_ = model.apply(
+                    {**params, "cache": vars_["cache"]},
+                    ids[:, t:t + 1], deterministic=True, decode=True,
+                    mutable=["cache"])
+                outs.append(step)
+            inc = jnp.concatenate(outs, axis=1)
+            np.testing.assert_allclose(
+                np.asarray(inc), np.asarray(full), atol=2e-5,
+                rtol=2e-5, err_msg=f"prefill={pre}")
+
+    def test_rolling_cache_prefill_longer_than_window(self):
+        """A prompt longer than the window wraps the ring during
+        prefill; subsequent decode must still match the full forward."""
+        cfg = LlamaConfig.tiny(sliding_window=5, scan_layers=False)
+        model = LlamaModel(cfg)
+        ids = jnp.asarray(np.random.default_rng(7).integers(
+            0, cfg.vocab_size, size=(2, 14)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        params = {"params": params["params"]}
+        full = model.apply(params, ids, deterministic=True)
+        # prefill 9 (> window 5), then decode the rest one by one
+        cache = init_cache(model, 2)
+        logits, vars_ = model.apply(
+            {**params, "cache": cache}, ids[:, :9],
+            deterministic=True, decode=True, mutable=["cache"])
+        outs = [logits]
+        for t in range(9, 14):
+            step, vars_ = model.apply(
+                {**params, "cache": vars_["cache"]}, ids[:, t:t + 1],
+                deterministic=True, decode=True, mutable=["cache"])
+            outs.append(step)
+        inc = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(inc), np.asarray(full), atol=2e-5, rtol=2e-5)
+
 
 class TestGenerate:
     def test_greedy_matches_full_forward_chain(self):
