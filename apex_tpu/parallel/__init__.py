@@ -26,6 +26,10 @@ from apex_tpu.parallel.ring_attention import (
     ring_attention,
     ring_self_attention,
 )
+from apex_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_self_attention,
+)
 from apex_tpu.parallel.launch import (
     init_distributed,
     is_distributed,
@@ -41,5 +45,6 @@ __all__ = [
     "distributed_fused_adam", "distributed_fused_lamb",
     "zero_param_specs", "zero_shardings",
     "ring_attention", "ring_self_attention",
+    "ulysses_attention", "ulysses_self_attention",
     "LARC",
 ]

@@ -1,0 +1,122 @@
+"""Ulysses sequence parallelism — all-to-all context parallelism.
+
+**Beyond-reference** (SURVEY.md §2.6 checklist, §5): the reference has
+no context parallelism at all; this module is the second CP strategy
+next to :mod:`apex_tpu.parallel.ring_attention`, trading the ring's
+O(cp) neighbor exchanges for TWO all-to-alls around one full-sequence
+attention (the DeepSpeed-Ulysses pattern):
+
+- input arrives sequence-sharded ``(b, s/cp, h, d)`` over the
+  ``context`` axis;
+- ``all_to_all`` re-shards heads↔sequence: every device then holds the
+  FULL sequence for ``h/cp`` of the heads;
+- attention runs locally through the Pallas flash kernel — the banded
+  sliding-window grid, in-kernel dropout, and the seq-aware block
+  autotuning all apply unchanged (the ring path has its own jnp
+  accumulation instead);
+- the output all-to-alls back to sequence-sharded.
+
+When to prefer which (both exact): Ulysses moves ``2·b·s/cp·h·d``
+elements per device per call in two collectives and keeps the
+attention itself a single dense kernel — best when ``h >= cp`` and the
+per-device full-sequence KV fits HBM.  Ring attention streams KV in
+``cp`` chunks with compute overlap and O(s/cp) KV memory — the choice
+for extreme lengths.  GQA: kv heads split naturally when
+``hk % cp == 0``; for ``cp % hk == 0`` the kv heads are repeated to
+``cp`` before the exchange (the repeat is wire-cheap: kv is
+``hk/h``-sized) — head-block alignment with the grouped q layout is
+preserved in both cases.
+
+Layout matches :func:`apex_tpu.ops.fused_attention`:
+``(batch, seq_local, heads, head_dim)``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.core.mesh import CONTEXT_AXIS
+from apex_tpu.ops.attention import fused_attention
+
+__all__ = ["ulysses_attention", "ulysses_self_attention"]
+
+
+def ulysses_attention(q, k, v, axis: str = CONTEXT_AXIS, *,
+                      causal: bool = False,
+                      scale: Optional[float] = None,
+                      window: Optional[int] = None,
+                      dropout_rate: float = 0.0,
+                      dropout_rng=None,
+                      implementation: Optional[str] = None):
+    """Exact attention over a sequence sharded on mesh axis ``axis``.
+
+    Must be called inside ``shard_map`` with ``axis`` manual;
+    ``q``/``k``/``v`` are local sequence shards ``(b, s_local, h|hk,
+    d)``; returns the local output shard ``(b, s_local, h, d)``.
+    Semantics (incl. GQA, ``window``, in-kernel dropout) match
+    :func:`apex_tpu.ops.fused_attention` on the gathered sequence.
+    Requires ``h % cp == 0`` and ``hk % cp == 0 or cp % hk == 0``.
+    """
+    cp = lax.axis_size(axis)
+    h, hk = q.shape[2], k.shape[2]
+    if h % cp:
+        raise ValueError(
+            f"ulysses needs num_heads ({h}) divisible by the context "
+            f"axis size ({cp}) — use ring_attention otherwise")
+    if hk % cp and cp % hk:
+        raise ValueError(
+            f"ulysses GQA needs kv heads ({hk}) divisible by cp ({cp}) "
+            f"or cp divisible by kv heads — got neither")
+    if hk % cp:
+        # fewer kv heads than devices: repeat groups so each device
+        # receives exactly one kv head; the contiguous q head blocks
+        # stay aligned with their group (verified in the test suite)
+        k = jnp.repeat(k, cp // hk, axis=2)
+        v = jnp.repeat(v, cp // hk, axis=2)
+
+    def seq_to_heads(x):
+        # (b, s/cp, hx, d) -> (b, s, hx/cp, d)
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    o = fused_attention(
+        q, k, v, causal=causal, scale=scale, window=window,
+        dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+        implementation=implementation)
+    # (b, s, h/cp, d) -> (b, s/cp, h, d)
+    return lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_self_attention(q, k, v, *, mesh: Mesh,
+                           axis: str = CONTEXT_AXIS,
+                           causal: bool = False,
+                           scale: Optional[float] = None,
+                           window: Optional[int] = None,
+                           batch_spec: Optional[Tuple] = None,
+                           implementation: Optional[str] = None):
+    """Convenience wrapper: global (b, S, h, d) arrays in, shard_map'd
+    Ulysses attention over ``axis`` inside.
+
+    ``batch_spec`` optionally names a mesh axis for the batch dim (e.g.
+    ``'data'``) so DP×CP compose; other dims are replicated.
+    """
+    bs = batch_spec
+    spec = P(bs, axis, None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, axis_names={axis} | ({bs} if bs else set()))
+    def run(ql, kl, vl):
+        return ulysses_attention(
+            ql, kl, vl, axis, causal=causal, scale=scale,
+            window=window, implementation=implementation)
+
+    return run(q, k, v)

@@ -1,0 +1,148 @@
+"""Ulysses (all-to-all) sequence parallelism vs single-device reference.
+
+Same golden pattern as the ring-attention suite: the sharded
+implementation is asserted against the eager composition on the
+gathered sequence, on the 8-virtual-device CPU mesh (the reference has
+no context parallelism at all — SURVEY.md §2.6 checklist)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.core import mesh as mesh_lib
+from apex_tpu.core.mesh import CONTEXT_AXIS, DATA_AXIS
+from apex_tpu.ops.attention import attention_reference
+from apex_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_self_attention,
+)
+
+
+@pytest.fixture
+def cp_mesh():
+    m = mesh_lib.initialize_mesh(context_parallel_size=4,
+                                 data_parallel_size=2)
+    yield m
+    mesh_lib.destroy_mesh()
+
+
+def _mk_qkv(rng, b, s, h, d, hk=None):
+    hk = h if hk is None else hk
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_reference(cp_mesh, rng, causal):
+    q, k, v = _mk_qkv(rng, 2, 32, 4, 8)
+    want = attention_reference(q, k, v, causal=causal)
+    got = jax.jit(functools.partial(
+        ulysses_self_attention, mesh=cp_mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_gqa_split(cp_mesh, rng):
+    # hk=8, cp=4: kv heads split naturally (2 per device)
+    q, k, v = _mk_qkv(rng, 2, 32, 8, 8, hk=8)
+    want = attention_reference(q, k, v, causal=True)
+    got = ulysses_self_attention(q, k, v, mesh=cp_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_gqa_repeat(cp_mesh, rng):
+    # hk=2 < cp=4: kv heads repeated to cp; q-group alignment must hold
+    q, k, v = _mk_qkv(rng, 2, 32, 8, 8, hk=2)
+    want = attention_reference(q, k, v, causal=True)
+    got = ulysses_self_attention(q, k, v, mesh=cp_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_sliding_window(cp_mesh, rng):
+    # the banded flash grid rides through the all-to-all layout
+    q, k, v = _mk_qkv(rng, 1, 64, 4, 8)
+    want = attention_reference(q, k, v, causal=True, window=10)
+    got = ulysses_self_attention(q, k, v, mesh=cp_mesh, causal=True,
+                                 window=10)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_grads_match_reference(cp_mesh, rng, causal):
+    q, k, v = _mk_qkv(rng, 1, 32, 4, 8)
+
+    def loss_sharded(q, k, v):
+        o = ulysses_self_attention(q, k, v, mesh=cp_mesh,
+                                   causal=causal)
+        return jnp.sum(jnp.tanh(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.tanh(
+            attention_reference(q, k, v, causal=causal)))
+
+    gs = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gs, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5,
+            err_msg=f"d{name}")
+
+
+def test_ulysses_gqa_repeat_grads(cp_mesh, rng):
+    q, k, v = _mk_qkv(rng, 1, 32, 8, 8, hk=2)
+
+    def loss_sharded(q, k, v):
+        o = ulysses_self_attention(q, k, v, mesh=cp_mesh, causal=True)
+        return jnp.sum(jnp.tanh(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.tanh(
+            attention_reference(q, k, v, causal=True)))
+
+    gs = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gs, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5,
+            err_msg=f"d{name}")
+
+
+def test_ulysses_composes_with_data_parallel(cp_mesh, rng):
+    q, k, v = _mk_qkv(rng, 2, 32, 4, 8)
+    want = attention_reference(q, k, v, causal=True)
+    got = ulysses_self_attention(q, k, v, mesh=cp_mesh, causal=True,
+                                 batch_spec=DATA_AXIS)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_head_divisibility_errors(cp_mesh, rng):
+    # h=6 not divisible by cp=4
+    q, k, v = _mk_qkv(rng, 1, 32, 6, 8)
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_self_attention(q, k, v, mesh=cp_mesh, causal=True)
+    # hk=3: neither hk % cp == 0 nor cp % hk == 0
+    q, k, v = _mk_qkv(rng, 1, 32, 12, 8, hk=3)
+    with pytest.raises(ValueError, match="kv heads"):
+        ulysses_self_attention(q, k, v, mesh=cp_mesh, causal=True)
+
+
+def test_ulysses_agrees_with_ring(cp_mesh, rng):
+    """The two CP strategies are exact: they must agree with each
+    other, not just with the reference."""
+    from apex_tpu.parallel.ring_attention import ring_self_attention
+
+    q, k, v = _mk_qkv(rng, 2, 32, 4, 8)
+    u = ulysses_self_attention(q, k, v, mesh=cp_mesh, causal=True)
+    r = ring_self_attention(q, k, v, mesh=cp_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(u), np.asarray(r),
+                               atol=1e-5, rtol=1e-5)
