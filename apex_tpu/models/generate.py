@@ -9,8 +9,12 @@ decode loop over :class:`~apex_tpu.models.gpt.GPTModel` /
 - the KV cache is a plain pytree (``init_cache`` — all-zero arrays of
   shape ``(b, max_seq_len, kv_heads, head_dim)`` per layer; GQA shrinks
   it by ``num_heads/num_kv_heads``),
-- prefill is ONE model call over the whole prompt (flash path
-  unnecessary: decode attention masks by absolute position),
+- prefill runs the prompt through the model's ``decode=True`` chunk
+  path — one call for short prompts, a ``lax.scan`` of fixed-size
+  chunks above ``prefill_chunk`` tokens (long prompts: the chunk path
+  uses the flash kernel / blocked cache attention, so a 32k prompt
+  compiles and its score temps stay O(chunk), see
+  ``models/transformer.py::ParallelAttention``),
 - the per-token loop is a ``lax.scan`` inside one ``jit`` — no host
   round-trips between tokens; greedy or temperature/top-k sampling via
   ``jax.random.categorical``.
@@ -18,8 +22,8 @@ decode loop over :class:`~apex_tpu.models.gpt.GPTModel` /
 Static-shape discipline: prompts share one length (pad-free; ragged
 batches should be bucketed by the caller) and ``max_new_tokens`` is
 static.  The compiled loop is cached per ``(model, max_new_tokens,
-temperature, top_k, eos_id)`` signature (jit handles the shape axis),
-so repeated same-shape calls do not retrace.
+temperature, top_k, eos_id, prefill_chunk)`` signature (jit handles
+the shape axis), so repeated same-shape calls do not retrace.
 """
 
 from __future__ import annotations
@@ -61,7 +65,8 @@ def init_cache(model, batch_size: int, *, prompt_len: int = 1,
 
 @functools.lru_cache(maxsize=64)
 def _compiled_run(model, max_new_tokens: int, temperature: float,
-                  top_k: Optional[int], eos_id: Optional[int]):
+                  top_k: Optional[int], eos_id: Optional[int],
+                  prefill_chunk: int = 0):
     """One jitted prefill+scan loop per static signature.
 
     ``model`` is a frozen flax module (hashable); jit's own cache
@@ -78,13 +83,40 @@ def _compiled_run(model, max_new_tokens: int, temperature: float,
             scaled = jnp.where(scaled < kth, -1e30, scaled)
         return jax.random.categorical(key, scaled).astype(jnp.int32)
 
+    def apply(variables, cache, ids):
+        logits, upd = model.apply(
+            {**variables, "cache": cache}, ids,
+            deterministic=True, decode=True, mutable=["cache"])
+        return logits, upd["cache"]
+
     @jax.jit
     def run(variables, cache, prompt_ids, rng):
-        b = prompt_ids.shape[0]
-        # prefill: one pass over the prompt populates every layer cache
-        logits, updated = model.apply(
-            {**variables, "cache": cache}, prompt_ids,
-            deterministic=True, decode=True, mutable=["cache"])
+        b, plen = prompt_ids.shape
+        if prefill_chunk and plen > prefill_chunk:
+            # chunked prefill: fixed-size chunks through the model's
+            # decode chunk path under one lax.scan (the leading
+            # remainder chunk keeps every scanned chunk the same
+            # static size); only the running last-token logits ride
+            # the carry, so nothing O(prompt·vocab) materializes
+            C = prefill_chunk
+            r = plen % C or C
+            logits, cache = apply(variables, cache, prompt_ids[:, :r])
+            last = logits[:, -1]
+            n = (plen - r) // C
+            if n:
+                chunks = prompt_ids[:, r:].reshape(b, n, C).swapaxes(0, 1)
+
+                def pre(carry, chunk):
+                    cache, _ = carry
+                    lg, cache = apply(variables, cache, chunk)
+                    return (cache, lg[:, -1]), None
+
+                (cache, last), _ = jax.lax.scan(pre, (cache, last),
+                                                chunks)
+            logits = last[:, None]
+        else:
+            # prefill: one pass over the prompt populates every cache
+            logits, cache = apply(variables, cache, prompt_ids)
         rng, key = jax.random.split(rng)
         tok = next_token(logits, key)
         # eos latches only on PRODUCED tokens — a prompt-contained
@@ -93,18 +125,16 @@ def _compiled_run(model, max_new_tokens: int, temperature: float,
 
         def step(carry, _):
             cache, tok, done, rng = carry
-            logits, upd = model.apply(
-                {**variables, "cache": cache}, tok[:, None],
-                deterministic=True, decode=True, mutable=["cache"])
+            logits, cache = apply(variables, cache, tok[:, None])
             rng, key = jax.random.split(rng)
             nxt = next_token(logits, key)
             if eos_id is not None:
                 done = done | (tok == eos_id)
                 nxt = jnp.where(done, eos_id, nxt)
-            return (upd["cache"], nxt, done, rng), tok
+            return (cache, nxt, done, rng), tok
 
         (_, last, _, _), toks = jax.lax.scan(
-            step, (updated["cache"], tok, done0, rng), None,
+            step, (cache, tok, done0, rng), None,
             length=max_new_tokens - 1)
         toks = jnp.moveaxis(toks, 0, 1)              # (b, n-1)
         return jnp.concatenate(
@@ -115,7 +145,8 @@ def _compiled_run(model, max_new_tokens: int, temperature: float,
 
 def generate(model, params, prompt_ids, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             rng=None, eos_id: Optional[int] = None):
+             rng=None, eos_id: Optional[int] = None,
+             prefill_chunk: Optional[int] = None):
     """Generate ``max_new_tokens`` continuations of ``prompt_ids``.
 
     ``prompt_ids``: ``(batch, prompt_len)`` int32 (one shared length —
@@ -124,6 +155,11 @@ def generate(model, params, prompt_ids, *, max_new_tokens: int,
     truncated).  After ``eos_id`` is *produced* a sequence keeps
     emitting ``eos_id`` (static shapes — no early exit under jit);
     eos tokens already in the prompt are ignored.
+
+    ``prefill_chunk``: process the prompt in fixed-size chunks of this
+    many tokens (bounds prefill score temps to O(chunk·window) /
+    O(chunk·prefix)).  ``None`` = auto: single-call prefill up to 8k
+    prompts, 2048-token chunks above.  Pass ``0`` to force single-call.
 
     Returns ``(batch, prompt_len + max_new_tokens)`` token ids.
     """
@@ -140,9 +176,21 @@ def generate(model, params, prompt_ids, *, max_new_tokens: int,
             f"({max_len}) — the KV cache cannot hold the sequence")
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature>0) needs an rng key")
+    if top_k is not None and not 1 <= top_k <= model.cfg.vocab_size:
+        # an out-of-range top_k silently clamps under jit (negative
+        # sort index -> minimum logit -> truncation silently disabled)
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={model.cfg.vocab_size}], "
+            f"got {top_k}")
+    if prefill_chunk is None:
+        prefill_chunk = 2048 if prompt_len > 8192 else 0
+    elif prefill_chunk < 0:
+        raise ValueError(
+            f"prefill_chunk must be >= 0, got {prefill_chunk}")
     rng = jax.random.PRNGKey(0) if rng is None else rng
     cache = init_cache(model, b)
     run = _compiled_run(model, int(max_new_tokens), float(temperature),
                         None if top_k is None else int(top_k),
-                        None if eos_id is None else int(eos_id))
+                        None if eos_id is None else int(eos_id),
+                        int(prefill_chunk))
     return run(dict(params), cache, prompt_ids, rng)

@@ -194,6 +194,89 @@ def _cache_attention(q, keys, values, idx, scale, window=None,
     return o.reshape(b, s, h, d).astype(q.dtype)
 
 
+def _cache_attention_blocked(q, keys, values, idx, scale, window=None,
+                             key_positions=None, block=1024):
+    """Chunk attention of ``q`` (b, s, h, d) over cached keys
+    (b, S, hk, d) in an online-softmax scan over key blocks — the jnp
+    analogue of the flash kernel's kv sweep, for the decode path where
+    keys live in the cache rather than in the chunk.
+
+    The one-shot masked einsum materializes (b, h, s, S) scores — the
+    exact O(S²) temp that BASELINE.md shows uncompilable at 32k — while
+    this form bounds temps to (b, h, s, block) per step.  With the
+    default slot-index positions (dense cache) blocks past the live
+    prefix are SKIPPED (``lax.cond`` on ``block_start <= idx+s-1``),
+    so compute scales with the filled cache, not ``max_seq_len``;
+    with explicit ``key_positions`` (ring concat — arbitrary per-slot
+    positions, -1 = dead) every block runs.  ``S`` is padded up to a
+    block multiple with dead keys (position -1 / past-the-end slots
+    are masked either way), so any cache length works.
+    """
+    b, s, h, d = q.shape
+    S, hk = keys.shape[1], keys.shape[2]
+    rep = h // hk
+    block = min(block, S)
+    pad = -S % block
+    if pad:
+        kpad = ((0, 0), (0, pad), (0, 0), (0, 0))
+        keys = jnp.pad(keys, kpad)
+        values = jnp.pad(values, kpad)
+        if key_positions is not None:
+            key_positions = jnp.pad(key_positions, (0, pad),
+                                    constant_values=-1)
+        # default positions: padded slots sit at S..S+pad-1, beyond
+        # every query position (idx + s <= max_seq_len = S) -> masked
+    nblk = (S + pad) // block
+    qg = (q.reshape(b, s, hk, rep, d).astype(jnp.float32)
+          * jnp.float32(scale))
+    pos_q = idx + jnp.arange(s)                       # (s,)
+    last_q = idx + s - 1
+
+    def body(carry, start):
+        m, l, acc = carry
+        kb = jax.lax.dynamic_slice_in_dim(
+            keys, start, block, 1).astype(jnp.float32)
+        vb = jax.lax.dynamic_slice_in_dim(
+            values, start, block, 1).astype(jnp.float32)
+        sc = jnp.einsum("bsgrd,bkgd->bsgrk", qg, kb)
+        if key_positions is None:
+            k_pos = start + jnp.arange(block)
+        else:
+            k_pos = jax.lax.dynamic_slice_in_dim(
+                key_positions, start, block, 0)
+        vis = ((k_pos[None, :] >= 0)
+               & (k_pos[None, :] <= pos_q[:, None]))  # (s, block)
+        if window is not None:
+            vis &= k_pos[None, :] > pos_q[:, None] - window
+        sc = jnp.where(vis[None, :, None, None, :], sc, -1e30)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1))
+        p = jnp.exp(sc - m_new[..., None])
+        p = jnp.where(sc < -0.5e30, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = (acc * alpha[..., None]
+               + jnp.einsum("bsgrk,bkgd->bsgrd", p, vb))
+        return (m_new, l, acc), None
+
+    def step(carry, blk):
+        start = blk * block
+        if key_positions is None:
+            # dense cache: slot index IS the position — blocks wholly
+            # past the newest query hold nothing visible
+            return jax.lax.cond(
+                start <= last_q,
+                lambda c: body(c, start)[0], lambda c: c, carry), None
+        return body(carry, start)[0], None
+
+    m0 = jnp.full((b, s, hk, rep), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, s, hk, rep), jnp.float32)
+    a0 = jnp.zeros((b, s, hk, rep, d), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        step, (m0, l0, a0), jnp.arange(nblk))
+    o = acc / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return o.reshape(b, s, h, d).astype(q.dtype)
+
+
 class ParallelAttention(nn.Module):
     """TP attention block: ColumnParallel qkv → RoPE → flash → RowParallel.
 
@@ -210,9 +293,12 @@ class ParallelAttention(nn.Module):
     d)`` — except with ``sliding_window``, where it is a
     window-sized RING BUFFER ``(b, window, kv_heads, d)`` plus a
     ``slot_positions`` leaf (position+1 per slot; 0 = empty), so
-    decode memory scales with the window, not ``max_seq_len``.  A
-    multi-token decode chunk must be the FIRST call (prefill); decode
-    one token at a time afterwards.
+    decode memory scales with the window, not ``max_seq_len``.
+    Multi-token chunks are supported at ANY cache position (chunked
+    prefill): the dense cache runs a blocked online-softmax scan over
+    the live prefix, the ring cache combines the banded flash kernel
+    with a ring-correction einsum for the first ``min(window, s)``
+    queries.
     """
 
     cfg: TransformerConfig
@@ -297,8 +383,18 @@ class ParallelAttention(nn.Module):
                 values = jax.lax.dynamic_update_slice_in_dim(
                     cv.value, v, idx, 1)
                 ck.value, cv.value = keys, values
-                o = _cache_attention(q, keys, values, idx, scale,
-                                     window=cfg.sliding_window)
+                # (window is always a no-op here: Wc is None only when
+                # sliding_window is unset or >= max_seq_len, and a
+                # window covering the whole cache masks nothing)
+                if s == 1:
+                    o = _cache_attention(q, keys, values, idx, scale)
+                else:
+                    # prefill / mid-stream chunk: online-softmax block
+                    # scan over the cache — the one-shot einsum's
+                    # (s, S) score temp is exactly what BASELINE.md
+                    # shows uncompilable at 32k prompts
+                    o = _cache_attention_blocked(
+                        q, keys, values, idx, scale)
             elif s == 1:
                 # steady decode: one slot write, attend over the ring
                 slot = idx % Wc
@@ -313,14 +409,29 @@ class ParallelAttention(nn.Module):
                                      window=Wc,
                                      key_positions=pos - 1)
             else:
-                # multi-token chunk = PREFILL (contract: must be the
-                # first call — a mid-stream chunk would need ring
-                # entries older than the chunk, which in-chunk writes
-                # may already have evicted).  Attention runs directly
-                # on the chunk (banded), then the last Wc keys enter
-                # the ring.
-                o = fused_attention(q, k, v, causal=True,
-                                    scale=scale, window=Wc)
+                # multi-token chunk at ANY position: the banded flash
+                # kernel covers in-chunk attention, and only queries in
+                # the chunk's first min(Wc, s) offsets can also see
+                # ring entries (offset i >= Wc has pos_q - Wc >= idx,
+                # putting every ring key out of window) — those rows
+                # are recomputed by a masked einsum over
+                # [ring ‖ chunk-head] with per-slot positions.  On the
+                # first call the ring is empty (slot_positions == 0 →
+                # k_pos == -1, masked), so prefill needs no special
+                # case.
+                hlen = min(Wc, s)
+                cat_k = jnp.concatenate([ck.value, k[:, :hlen]], axis=1)
+                cat_v = jnp.concatenate([cv.value, v[:, :hlen]], axis=1)
+                cat_pos = jnp.concatenate(
+                    [cp.value - 1, idx + jnp.arange(hlen)])
+                o = _cache_attention_blocked(
+                    q[:, :hlen], cat_k, cat_v, idx, scale, window=Wc,
+                    key_positions=cat_pos)
+                if s > hlen:
+                    o_tail = fused_attention(
+                        q, k, v, causal=True, scale=scale,
+                        window=Wc)[:, hlen:]
+                    o = jnp.concatenate([o, o_tail], axis=1)
                 tail = min(s, Wc)
                 positions = idx + s - tail + jnp.arange(tail)
                 slots = positions % Wc

@@ -42,7 +42,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.core.mesh import CONTEXT_AXIS
-from apex_tpu.ops.attention import fused_attention
+from apex_tpu.ops.attention import _derive_seed, fused_attention
 
 __all__ = ["ulysses_attention", "ulysses_self_attention"]
 
@@ -59,11 +59,26 @@ def ulysses_attention(q, k, v, axis: str = CONTEXT_AXIS, *,
     Must be called inside ``shard_map`` with ``axis`` manual;
     ``q``/``k``/``v`` are local sequence shards ``(b, s_local, h|hk,
     d)``; returns the local output shard ``(b, s_local, h, d)``.
-    Semantics (incl. GQA, ``window``, in-kernel dropout) match
+    Semantics (incl. GQA and ``window``) match
     :func:`apex_tpu.ops.fused_attention` on the gathered sequence.
+    Dropout is statistically equivalent but NOT bit-identical to the
+    unsharded call: the seed is folded with ``lax.axis_index(axis)`` so
+    head shards on different devices draw independent masks (without
+    the fold, every shard's local lane indices coincide and global
+    heads ``h/cp`` apart would share one mask).
     Requires ``h % cp == 0`` and ``hk % cp == 0 or cp % hk == 0``.
     """
     cp = lax.axis_size(axis)
+    # dropout_rng=None with rate>0 passes through untouched so
+    # fused_attention's "dropout needs an rng" guard still raises
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        # mix the shard index into the normalized int32 seed (handles
+        # keys AND integer seeds uniformly — _derive_seed is the same
+        # normalization fused_attention itself applies)
+        seed = _derive_seed(dropout_rng)[0].astype(jnp.uint32)
+        mix = ((lax.axis_index(axis).astype(jnp.uint32)
+                + jnp.uint32(1)) * jnp.uint32(0x9E3779B9))
+        dropout_rng = (seed ^ mix).astype(jnp.int32)
     h, hk = q.shape[2], k.shape[2]
     if h % cp:
         raise ValueError(

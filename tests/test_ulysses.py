@@ -146,3 +146,37 @@ def test_ulysses_agrees_with_ring(cp_mesh, rng):
     r = ring_self_attention(q, k, v, mesh=cp_mesh, causal=True)
     np.testing.assert_allclose(np.asarray(u), np.asarray(r),
                                atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("seed_form", ["int", "key", "int32_array"])
+def test_ulysses_dropout_decorrelated_across_shards(cp_mesh, rng,
+                                                    seed_form):
+    """In-kernel dropout under Ulysses folds the shard index into the
+    seed (round-4 advisor finding): with identical per-head q/k/v,
+    global heads on DIFFERENT context shards must draw different
+    masks — without the fold, every shard's local lane indices
+    coincide and heads h/cp apart would share one mask.  All seed
+    forms fused_attention accepts must survive the fold."""
+    b, s, h, d = 1, 32, 4, 8          # cp=4 -> one head per shard
+    one = jnp.asarray(rng.standard_normal((b, s, 1, d)), jnp.float32)
+    q = jnp.broadcast_to(one, (b, s, h, d))
+    k = jnp.broadcast_to(one, (b, s, h, d))
+    v = jnp.broadcast_to(one, (b, s, h, d))
+    seed = {"int": 7, "key": jax.random.PRNGKey(7),
+            "int32_array": jnp.int32(7)}[seed_form]
+    spec = P(None, CONTEXT_AXIS, None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=cp_mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, axis_names={CONTEXT_AXIS})
+    def run(ql, kl, vl):
+        return ulysses_attention(ql, kl, vl, CONTEXT_AXIS,
+                                 dropout_rate=0.5, dropout_rng=seed)
+
+    out = np.asarray(run(q, k, v))     # (b, s, h, d)
+    assert np.isfinite(out).all()
+    for i in range(h):
+        for j in range(i + 1, h):
+            assert not np.allclose(out[:, :, i], out[:, :, j]), (
+                f"heads {i} and {j} (different shards) share a "
+                f"dropout mask ({seed_form})")
