@@ -11,6 +11,9 @@ import torch
 from apex_tpu import ops
 from apex_tpu.ops.attention import fused_attention, attention_reference
 
+# L0 fast tier: golden kernel/state-machine tests (pytest -m l0)
+pytestmark = pytest.mark.l0
+
 D = 128
 
 

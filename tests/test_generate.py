@@ -191,6 +191,7 @@ class TestMidStreamChunks:
 
 
 class TestGenerate:
+    @pytest.mark.l0
     def test_greedy_matches_full_forward_chain(self):
         cfg = GPTConfig.tiny(position_embedding="learned",
                              scan_layers=True)

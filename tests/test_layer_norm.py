@@ -12,6 +12,9 @@ import torch
 
 from apex_tpu import ops
 
+# L0 fast tier: golden kernel/state-machine tests (pytest -m l0)
+pytestmark = pytest.mark.l0
+
 H = 256  # lane-aligned hidden size so the Pallas path engages
 
 

@@ -5,9 +5,13 @@ overflow, step skipping)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu import DynamicLossScale, StaticLossScale, NoOpLossScale, all_finite
 from apex_tpu.core.loss_scale import LossScaleState
+
+# L0 fast tier: golden kernel/state-machine tests (pytest -m l0)
+pytestmark = pytest.mark.l0
 
 
 class TestAllFinite:

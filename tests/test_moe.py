@@ -45,6 +45,7 @@ class TestGating:
 
 
 class TestMoEMLP:
+    @pytest.mark.l0
     def test_matches_manual_expert_computation(self, rng):
         cfg = MoEConfig(num_experts=4, top_k=1, hidden_size=8,
                         ffn_hidden_size=16, capacity_factor=4.0,

@@ -10,6 +10,9 @@ import torch
 
 from apex_tpu import ops
 
+# L0 fast tier: golden kernel/state-machine tests (pytest -m l0)
+pytestmark = pytest.mark.l0
+
 
 def _x(rng, shape, dtype=jnp.float32):
     return jnp.asarray(rng.normal(size=shape), dtype)

@@ -15,6 +15,9 @@ import torch
 
 from apex_tpu import optim as ao
 
+# L0 fast tier: golden kernel/state-machine tests (pytest -m l0)
+pytestmark = pytest.mark.l0
+
 
 def _rand_params(rng, shapes):
     return {f"p{i}": jnp.asarray(rng.normal(size=s), jnp.float32)

@@ -46,6 +46,7 @@ class TestSyncBatchNorm:
         np.testing.assert_allclose(np.asarray(var), want_var,
                                    rtol=1e-4, atol=1e-6)
 
+    @pytest.mark.l0
     def test_module_matches_single_device_bn(self, dp_mesh, rng):
         # the reference's canonical test: 2-process SyncBN == 1-process BN
         x = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
@@ -147,6 +148,7 @@ class TestSyncBatchNorm:
 
 
 class TestDDP:
+    @pytest.mark.l0
     def test_sharded_training_matches_single_device(self, dp_mesh, rng):
         # end-to-end: DP training step over 8 shards == single-device
         # step on the full batch (apex DDP's correctness contract)

@@ -62,6 +62,7 @@ def _sequential_reference(stacked, batch, m):
 
 class TestPipelineSchedule:
     @pytest.mark.parametrize("m", [2, 4, 6])
+    @pytest.mark.l0
     def test_matches_sequential(self, rng, mesh8, m):
         pp = mesh8.shape[PIPE_AXIS]
         stacked = _stacked_params(rng, pp)

@@ -9,6 +9,9 @@ import pytest
 from apex_tpu import PrecisionPolicy
 from apex_tpu.core.precision import tree_cast
 
+# L0 fast tier: golden kernel/state-machine tests (pytest -m l0)
+pytestmark = pytest.mark.l0
+
 
 def _params():
     return {

@@ -59,6 +59,7 @@ def test_ring_gqa(cp_mesh, rng):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.l0
 def test_ring_grads_match_reference(cp_mesh, rng, causal):
     q, k, v = _mk_qkv(rng, 1, 32, 2, 8)
     w = jnp.asarray(rng.standard_normal((2, 32, 2, 8)), jnp.float32)

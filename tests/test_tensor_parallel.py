@@ -113,6 +113,7 @@ class TestTPLinearFunctions:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.l0
     def test_grads_match_dense(self, tp_mesh, rng):
         # canonical shard_map TP training pattern: the per-shard loss is
         # the FULL loss (output replicated after reduce_from); grads are
