@@ -20,6 +20,7 @@ free because everything is functional).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Optional, Tuple
 
 import jax
@@ -387,7 +388,22 @@ class ParallelAttention(nn.Module):
                 # sliding_window is unset or >= max_seq_len, and a
                 # window covering the whole cache masks nothing)
                 if s == 1:
-                    o = _cache_attention(q, keys, values, idx, scale)
+                    # steady decode reads the WHOLE (b, S, hk, d) cache
+                    # every token in the one-shot einsum; the blocked
+                    # form's lax.cond skip bounds reads to the live
+                    # prefix — a real-bandwidth win once the cache is
+                    # long (measured in the decode bench: BASELINE.md
+                    # round-5 decode section), so it is the default
+                    # from 4096 slots up.  APEX_TPU_DECODE_ATTN
+                    # ∈ {einsum, blocked} overrides for A/B.
+                    mode = os.environ.get("APEX_TPU_DECODE_ATTN", "auto")
+                    if mode == "blocked" or (
+                            mode == "auto" and S >= 4096):
+                        o = _cache_attention_blocked(
+                            q, keys, values, idx, scale, block=512)
+                    else:
+                        o = _cache_attention(q, keys, values, idx,
+                                             scale)
                 else:
                     # prefill / mid-stream chunk: online-softmax block
                     # scan over the cache — the one-shot einsum's

@@ -314,7 +314,8 @@ _PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
 _PEAK_HBM_GBS = float(os.environ.get("BENCH_PEAK_HBM_GBS", "819"))
 
 
-def _roofline_fields(compiled, dt, measured_tflops=None):
+def _roofline_fields(compiled, dt, measured_tflops=None,
+                     phase_bounds=None):
     """Self-certifying scoreboard (round-2 verdict weak #1, flag rules
     re-grounded in round 4 so no flag fires by design on known-good
     captures): emit the capture's achieved TFLOP/s, its fraction of the
@@ -342,6 +343,21 @@ def _roofline_fields(compiled, dt, measured_tflops=None):
       the MXU), instead of permanently firing on them (round-3 verdict
       weak #4).
 
+    ``phase_bounds`` (round-5): a list of ``{"name", "seconds",
+    "flops"}`` for work XLA's cost model CANNOT see — Pallas custom
+    calls report ``flops: None`` (probed this round), so a program
+    dominated by the flash kernel would otherwise score its bound on
+    the non-attention remainder only (exactly what round 4's 16k/32k
+    "kernel-own bound" rows did, making them accidentally loose).
+    With phases, the bound is the SUM of the XLA-visible roofline and
+    each phase's seconds (its analytic useful flops at its measured
+    kernel rate — tools/attn_bench.py accounting), ``achieved_tflops``
+    includes the phase flops, and each phase's ``xla_bytes`` (the
+    kernel's argument/result I/O, which XLA's bytes-accessed already
+    counts) is DEDUCTED from the XLA byte side so the same traffic is
+    never in both terms — double-counting would inflate the bound and
+    overstate ``roofline_frac``.
+
     ``roofline_frac`` ≈ 1 on an unflagged capture means the step runs
     at its program's bound (HBM for the BERT step).  Only computed on
     TPU backends.
@@ -365,10 +381,19 @@ def _roofline_fields(compiled, dt, measured_tflops=None):
         return {}
     if not flops or not dt:
         return {}
-    achieved = flops / dt / 1e12
+    phase_flops = sum(p["flops"] for p in phase_bounds or [])
+    phase_s = sum(p["seconds"] for p in phase_bounds or [])
+    # the kernels' argument/result bytes appear in XLA's "bytes
+    # accessed" AND inside the phase's measured wall time — subtract
+    # the analytic kernel I/O (phase "xla_bytes") from the XLA side so
+    # the composed bound never counts the same traffic twice (which
+    # would inflate the bound and overstate roofline_frac)
+    phase_io = sum(p.get("xla_bytes", 0) for p in phase_bounds or [])
+    byts_eff = max(byts - phase_io, 0.0)
+    achieved = (flops + phase_flops) / dt / 1e12
     t_mxu = flops / (_PEAK_TFLOPS * 1e12)
-    t_hbm = byts / (_PEAK_HBM_GBS * 1e9)
-    bound = max(t_mxu, t_hbm)
+    t_hbm = byts_eff / (_PEAK_HBM_GBS * 1e9)
+    bound = max(t_mxu, t_hbm) + phase_s
     if measured_tflops:
         bound = max(bound, flops / (measured_tflops * 1e12))
     frac = bound / dt
@@ -386,7 +411,8 @@ def _roofline_fields(compiled, dt, measured_tflops=None):
     out = {
         "achieved_tflops": round(achieved, 2),
         "roofline_frac": round(frac, 3),
-        "roofline_bound": ("measured_kernel" if measured_tflops and
+        "roofline_bound": ("phase_sum" if phase_bounds
+                           else "measured_kernel" if measured_tflops and
                            flops / (measured_tflops * 1e12) >=
                            max(t_mxu, t_hbm)
                            else "hbm" if t_hbm >= t_mxu else "mxu"),
@@ -398,6 +424,19 @@ def _roofline_fields(compiled, dt, measured_tflops=None):
         "peak_hbm_gbs_assumed": _PEAK_HBM_GBS,
         "flags": flags,
     }
+    if phase_bounds:
+        out["phase_bounds"] = [
+            {"name": p["name"], "seconds": round(p["seconds"], 5),
+             "flops": p["flops"],
+             "xla_bytes_deducted": p.get("xla_bytes", 0),
+             "rate_tflops": round(p["flops"] / p["seconds"] / 1e12, 1)}
+            for p in phase_bounds]
+        out["cost_bytes_minus_kernel_io"] = byts_eff
+        out["phase_note"] = (
+            "bound = XLA-visible roofline (kernel I/O bytes deducted) "
+            "+ sum of phase bounds; Pallas kernels report flops=None "
+            "to cost_analysis, so their work is accounted analytically "
+            "per phase")
     if measured_tflops:
         out["measured_bound_tflops"] = measured_tflops
     if 1.02 < t_hbm / dt <= 1.25:

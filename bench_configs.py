@@ -36,8 +36,35 @@ def _emit(d):
     print(json.dumps(d))
 
 
+def _run_child(leg, env_overrides=None, timeout=1800):
+    """Run one leg in a fresh subprocess and parse its last JSON line.
+
+    The single implementation behind every orchestrator (llama_1b /
+    decode / long_context rows and _run_all): fresh process per
+    measurement because HBM is not reclaimed promptly across builds on
+    the tunneled chip.  Returns a result dict; timeouts and non-zero
+    exits become ``{"error": ...}`` rows so sibling measurements are
+    never lost."""
+    env = dict(os.environ)
+    for k, v in (env_overrides or {}).items():
+        if v is None:
+            env.pop(k, None)            # None = remove from child env
+        else:
+            env[k] = v
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, leg], env=env,
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout}s"}
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        return {"error": (proc.stderr or proc.stdout or "?")[-2000:]}
+    return json.loads(lines[-1])
+
+
 def _measure(state, step, batch, samples_per_step, extra=None,
-             measured_tflops=None):
+             measured_tflops=None, phase_bounds=None):
     n_steps = int(os.environ.get("BENCH_STEPS", "20"))
     k_windows = max(1, int(os.environ.get("BENCH_WINDOWS", "3")))
     # AOT-compile: the executable doubles as the memory/cost analysis
@@ -56,7 +83,8 @@ def _measure(state, step, batch, samples_per_step, extra=None,
     }
     out.update(bench._memory_fields(compiled))
     out.update(bench._roofline_fields(compiled, dt,
-                                      measured_tflops=measured_tflops))
+                                      measured_tflops=measured_tflops,
+                                      phase_bounds=phase_bounds))
     out.update(extra or {})
     return out
 
@@ -203,14 +231,38 @@ def bench_gpt2_1p3b():
     state alone — more than the tunneled chip's usable HBM).  The
     reported number is the *proxy's* measured throughput, not an
     extrapolation; the full-size model is EXECUTED on the 8-device mesh
-    by the ``gpt2_tp8_full_step`` / ``gpt2_3d_full_step`` legs."""
+    by the ``gpt2_tp8_full_step`` / ``gpt2_3d_full_step`` legs.
+
+    BENCH_GPT_VARIANT (round-4 verdict item 4 — the optimizer-overlap
+    experiment; results + mechanism in BASELINE.md round-5 section):
+      base       the production step (apply_gradients).
+      noselect   per-leaf Adam applied UNconditionally (no DLS
+                 step-skip select): removes the only data dependency
+                 that could serialize the update behind the global
+                 finite-flag, and removes the select's 3-pass master
+                 traffic — an UPPER BOUND on what any finite-flag
+                 restructuring could buy.
+      fused_cast the state carries the bf16 compute copy; each update
+                 emits (new master, new copy) in one fusion, so the
+                 forward never re-reads the 5.3 GB fp32 masters — a
+                 pure traffic-elimination lever (O2 semantics intact:
+                 the copy equals cast_to_compute(master) bit-exactly,
+                 and on overflow both are rolled back).
+    The optimizer-only probe (t_opt_alone) is measured in every
+    variant: step_ms vs fwd_bwd_ms + t_opt_alone quantifies how much
+    of the optimizer's HBM streaming XLA actually hides under the
+    backward (TPU executes one op at a time — overlap can only come
+    from fusion, not concurrent kernels)."""
     import jax
     import jax.numpy as jnp
 
     from apex_tpu import amp
+    from apex_tpu.core.loss_scale import all_finite
     from apex_tpu.models import GPTModel, gpt_loss_fn
     from apex_tpu.optim import fused_adam
+    from apex_tpu.utils.tree import tree_select
 
+    variant = os.environ.get("BENCH_GPT_VARIANT", "base")
     layers = int(os.environ.get("BENCH_GPT_LAYERS", "12"))
     # b=8 measured +10.7% over round-3's b=4 (29.4 vs 26.5 samples/s
     # at full settings, round 4): the ~21 GB/step of per-param state
@@ -225,30 +277,179 @@ def bench_gpt2_1p3b():
     ids = jax.random.randint(
         jax.random.PRNGKey(0), (b, s + 1), 0, cfg.vocab_size, jnp.int32)
     inputs, labels = ids[:, :-1], ids[:, 1:]
-    params = model.init(jax.random.PRNGKey(0), inputs[:2])
-    state = amp.initialize(
-        model.apply, params,
-        fused_adam(1e-4, moment_dtype=jnp.bfloat16),
-        opt_level="O2", half_dtype=jnp.bfloat16)
+    tx = fused_adam(1e-4, moment_dtype=jnp.bfloat16)
+
+    def make_state():
+        params = model.init(jax.random.PRNGKey(0), inputs[:2])
+        return amp.initialize(
+            model.apply, params, tx, opt_level="O2",
+            half_dtype=jnp.bfloat16)
+
+    state = make_state()
+
+    def loss_of(state, cp, inputs, labels):
+        logits = state.apply_fn(cp, inputs)
+        loss = gpt_loss_fn(logits.astype(jnp.float32), labels)
+        return state.scale_loss(loss), loss
+
+    import optax as _optax
+
+    # each variant defines grad_of (how the step differentiates) and
+    # apply_opt (its post-grad optimizer sequence); step AND both
+    # probes are assembled from the SAME two functions, so the probes
+    # time exactly the computation the step runs (no probe drift)
+    if variant in ("base", "noselect"):
+        def grad_of(carry, inputs, labels):
+            state = carry
+
+            def loss_fn(p):
+                return loss_of(state, state.policy.cast_to_compute(p),
+                               inputs, labels)
+
+            return jax.grad(loss_fn, has_aux=True)(state.params)
+
+        if variant == "base":
+            def apply_opt(carry, grads):
+                return carry.apply_gradients(grads=grads)
+        else:
+            def apply_opt(state, grads):
+                ls = state.loss_scaler
+                grads = jax.tree.map(
+                    lambda g, p: g.astype(p.dtype), grads,
+                    state.params)
+                grads = ls.unscale(state.loss_scale_state, grads)
+                finite = all_finite(grads)
+                updates, new_opt = state.tx.update(
+                    grads, state.opt_state, state.params)
+                new_params = _optax.apply_updates(state.params,
+                                                  updates)
+                new_state = state.replace(
+                    step=state.step + 1, params=new_params,
+                    opt_state=new_opt,
+                    loss_scale_state=ls.adjust(
+                        state.loss_scale_state, finite))
+                return new_state, finite
+        carry = state
+    elif variant == "fused_cast":
+        # the copy casts EVERY leaf to bf16 (unlike cast_to_compute,
+        # which keeps norm params fp32 and would alias those buffers
+        # between master and copy — an illegal double-donation): this
+        # is a traffic experiment, and the ~0.1% of params that are
+        # norms don't move the numbers either way
+        def to_copy(p):
+            return jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16), p)
+
+        def grad_of(carry, inputs, labels):
+            state, copy = carry
+            return jax.grad(
+                lambda cp: loss_of(state, cp, inputs, labels),
+                has_aux=True)(copy)
+
+        def apply_opt(carry, grads):
+            state, copy = carry
+            # O2 grads arrive in bf16 (w.r.t. the compute copy) —
+            # upcast+unscale exactly as apply_gradients does
+            ls = state.loss_scaler
+            grads = jax.tree.map(
+                lambda g, p: g.astype(p.dtype), grads, state.params)
+            grads = ls.unscale(state.loss_scale_state, grads)
+            finite = all_finite(grads)
+            updates, new_opt = state.tx.update(
+                grads, state.opt_state, state.params)
+            new_params = _optax.apply_updates(state.params, updates)
+            # the next step's bf16 copy comes out of the same fusion
+            # that writes the new master — one master read total
+            new_copy = to_copy(new_params)
+            new_params = tree_select(finite, new_params, state.params)
+            new_copy = tree_select(finite, new_copy, copy)
+            new_opt = tree_select(finite, new_opt, state.opt_state)
+            new_state = state.replace(
+                step=state.step + 1, params=new_params,
+                opt_state=new_opt,
+                loss_scale_state=ls.adjust(state.loss_scale_state,
+                                           finite))
+            return (new_state, new_copy), finite
+    else:
+        raise ValueError(f"unknown BENCH_GPT_VARIANT {variant!r}")
+
+    def make_carry(st):
+        return (st, to_copy(st.params)) if variant == "fused_cast" \
+            else st
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(state, inputs, labels):
-        def loss_fn(p):
-            cp = state.policy.cast_to_compute(p)
-            logits = state.apply_fn(cp, inputs)
-            loss = gpt_loss_fn(logits.astype(jnp.float32), labels)
-            return state.scale_loss(loss), loss
+    def step(carry, inputs, labels):
+        grads, loss = grad_of(carry, inputs, labels)
+        new_carry, finite = apply_opt(carry, grads)
+        return new_carry, loss, finite
 
-        grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
-        new_state, finite = state.apply_gradients(grads=grads)
-        return new_state, loss, finite
+    # optimizer-only probe: the un-overlapped cost of THIS variant's
+    # post-grad sequence.  Grads ride in as real arguments in the
+    # dtype grad_of produces, the probe returns the FULL new carry
+    # (all moment/master writes must materialize — returning scalars
+    # would let XLA shrink the streaming to per-leaf slices), and
+    # carry+grads are donated and threaded through the window loop so
+    # the probe never holds two full states (the grads input rides
+    # back out as an aliased passthrough).  The probed carry is
+    # consumed; a fresh state is built for the probes/step after.
+    import time as _time
 
-    out = _measure(state, step, (inputs, labels), b,
+    gdtype = (jnp.bfloat16 if variant == "fused_cast"
+              else jnp.float32)
+    gprobe = jax.tree.map(
+        lambda p: jnp.full(p.shape, 1e-4, gdtype), state.params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def opt_only(carry, grads):
+        new_carry, _finite = apply_opt(carry, grads)
+        return new_carry, grads
+
+    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
+    k_windows = max(1, int(os.environ.get("BENCH_WINDOWS", "3")))
+    n_probe = max(n_steps // 2, 5)
+    box = [make_carry(state), gprobe]
+    del state, gprobe
+    box[:] = opt_only(*box)                    # warm + compile
+    bench._sync(box[0])
+
+    def opt_window():
+        c, g = box
+        t0 = _time.perf_counter()
+        for _ in range(n_probe):
+            c, g = opt_only(c, g)
+        bench._sync(c)
+        box[:] = [c, g]
+        return (_time.perf_counter() - t0) / n_probe
+
+    t_opt, _ = bench._time_windows(opt_window, k_windows)
+    del box
+
+    carry = make_carry(make_state())
+
+    @jax.jit
+    def fwd_bwd(carry, inputs, labels):
+        grads, loss = grad_of(carry, inputs, labels)
+        acc = loss
+        for g in jax.tree.leaves(grads):
+            acc = acc + g.ravel()[0].astype(loss.dtype)
+        return acc
+
+    t_fb = bench._measure_fn(
+        fwd_bwd, carry, (inputs, labels), n_probe, k_windows)
+
+    out = _measure(carry, step, (inputs, labels), b,
                    {"batch": b, "seq": s, "num_layers": layers,
+                    "variant": variant,
                     "tokens_per_sec": None})
     out["tokens_per_sec"] = round(out["value"] * s, 1)
+    out["fwd_bwd_ms"] = round(t_fb * 1e3, 2)
+    out["opt_alone_ms"] = round(t_opt * 1e3, 2)
+    out["overlap_hidden_ms"] = round(
+        max(t_fb + t_opt - out["step_ms"] / 1e3, 0.0) * 1e3, 2)
     out["metric"] = (f"gpt2_1p3b_proxy{layers}L_O2_fusedadam_"
                      "samples_per_sec_per_chip")
+    if variant != "base":
+        out["metric"] += f"_{variant}"
     _emit(out)
 
 
@@ -589,6 +790,193 @@ def bench_gpt2_3d_full_step():
     })
 
 
+def bench_mistral7b_tp8_full_step():
+    """EXECUTE one full O2+FusedAdam+DLS train step of the 7.24B
+    ``mistral_7b`` preset — GQA (8 kv heads over TP=8 → exactly one kv
+    head per shard, the divisibility edge), SwiGLU gated MLP, RMSNorm,
+    untied head — under TP=8 + sequence parallelism on the 8-device
+    virtual CPU mesh, asserting a finite, ln(V)-plausible init loss
+    (round-4 verdict item 3: promote the 7B presets + GQA sharding
+    from config-file claims to executed capability).
+
+    CPU-host memory shape: XLA:CPU does not honor buffer donation for
+    SHARDED computations (re-probed this round: an 8 GB donated
+    mesh-sharded array peaks at 17 GB; single-device peaks at 8.6 GB),
+    so a one-jit state→state step would materialize the 7B O2 state
+    twice (2 × 58 GB) plus transients — past the 125 GB host.  The leg
+    therefore runs the step in two phases with IDENTICAL math:
+    (1) one sharded jit computing scaled-loss grads w.r.t. the fp32
+    masters, (2) the optimizer/DLS sequence of
+    ``MixedPrecisionTrainState.apply_gradients`` applied leaf-wise
+    (upcast → unscale → finite-AND → FusedAdam update → select →
+    scale-adjust), bounding live temps to one stacked leaf.  Per-leaf
+    unscaled finiteness equals after-unscale finiteness (x/scale with
+    scale ≥ 1 preserves inf/nan and finiteness).  On a real TPU mesh
+    the same step runs as ONE jit with donation — this split is a
+    host-RAM accommodation, not a framework limitation."""
+    import functools as ft
+    import resource
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import flax.linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu import amp
+    from apex_tpu.core import mesh as mesh_lib
+    from apex_tpu.models import LlamaConfig, LlamaModel, gpt_loss_fn
+    from apex_tpu.optim import fused_adam
+
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+    mesh = mesh_lib.initialize_mesh(tensor_model_parallel_size=8)
+    b = int(os.environ.get("BENCH_BATCH", "1"))
+    s = int(os.environ.get("BENCH_SEQ", "512"))
+    cfg = LlamaConfig.mistral_7b(
+        max_seq_len=s, dtype=jnp.bfloat16, remat=True,
+        scan_layers=True, sequence_parallel=True,
+        # full 32 layers by default; override only for smoke tests
+        num_layers=int(os.environ.get("BENCH_7B_LAYERS", "32")))
+    model = LlamaModel(cfg)
+    ids0 = jnp.zeros((b, s), jnp.int32)
+    # bf16 moments as the gpt2 legs: fp32 moments alone are 58 GB
+    tx = fused_adam(1e-4, moment_dtype=jnp.bfloat16)
+
+    def create_state():
+        params = model.init(jax.random.PRNGKey(0), ids0)
+        return amp.initialize(model.apply, params, tx,
+                              opt_level="O2", half_dtype=jnp.bfloat16)
+
+    state_shape = jax.eval_shape(create_state)
+    specs = nn.get_partition_spec(state_shape)
+    shardings = jax.tree.map(
+        lambda sp: NamedSharding(mesh, sp), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    data_sharding = NamedSharding(mesh, P("data"))
+    n_params = sum(
+        x.size for x in jax.tree.leaves(state_shape.params)
+        if hasattr(x, "size"))
+
+    def grad_step(state, inputs, labels):
+        def loss_fn(p):
+            cp = state.policy.cast_to_compute(p)
+            logits = state.apply_fn(cp, inputs)
+            loss = gpt_loss_fn(logits, labels)
+            return state.scale_loss(loss), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
+        return grads, loss
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1))
+    ln_v = float(np.log(cfg.vocab_size))
+    with jax.set_mesh(mesh):
+        jitted = jax.jit(
+            grad_step,
+            in_shardings=(shardings, data_sharding, data_sharding),
+            out_shardings=(shardings.params, None))
+        compiled = jitted.lower(
+            state_shape,
+            jax.ShapeDtypeStruct((b, s), jnp.int32),
+            jax.ShapeDtypeStruct((b, s), jnp.int32)).compile()
+        mem = compiled.memory_analysis()
+        state = jax.jit(create_state, out_shardings=shardings)()
+        inputs = jax.device_put(
+            jnp.asarray(tokens[:, :-1], jnp.int32), data_sharding)
+        labels = jax.device_put(
+            jnp.asarray(tokens[:, 1:], jnp.int32), data_sharding)
+
+        t0 = time.perf_counter()
+        grads, sloss = compiled(state, inputs, labels)
+        sloss = float(sloss)        # sync: grads materialized
+        t_grads = time.perf_counter() - t0
+
+        # phase 2: apply_gradients leaf-wise (identical sequence) ----
+        ls, ls_state = state.loss_scaler, state.loss_scale_state
+        scale = ls_state.loss_scale
+
+        @jax.jit
+        def leaf_finite(g, scale):
+            return jnp.isfinite(g.astype(jnp.float32) / scale).all()
+
+        finite = jnp.asarray(True)
+        for g in jax.tree.leaves(grads):
+            finite = finite & leaf_finite(g, scale)
+
+        @jax.jit
+        def leaf_update(p, m, v, g, count, scale, finite):
+            g = g.astype(p.dtype) / scale          # upcast → unscale
+            upd, new = tx.update(
+                {"x": g},
+                type(state.opt_state)(
+                    count=count, exp_avg={"x": m}, exp_avg_sq={"x": v}),
+                {"x": p})
+            new_p = p + upd["x"]
+            sel = lambda a, b: jnp.where(finite, a, b)
+            return (sel(new_p, p), sel(new.exp_avg["x"], m),
+                    sel(new.exp_avg_sq["x"], v), new.count)
+
+        params = state.params
+        opt = state.opt_state
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_m = treedef.flatten_up_to(opt.exp_avg)
+        flat_v = treedef.flatten_up_to(opt.exp_avg_sq)
+        flat_g = treedef.flatten_up_to(grads)
+        del grads, params
+        new_count = opt.count
+        for i in range(len(flat_p)):
+            flat_p[i], flat_m[i], flat_v[i], new_count = leaf_update(
+                flat_p[i], flat_m[i], flat_v[i], flat_g[i],
+                opt.count, scale, finite)
+            flat_g[i] = None                       # free as we go
+        new_params = jax.tree.unflatten(treedef, flat_p)
+        new_opt = type(opt)(
+            count=jnp.where(finite, new_count, opt.count),
+            exp_avg=jax.tree.unflatten(treedef, flat_m),
+            exp_avg_sq=jax.tree.unflatten(treedef, flat_v))
+        new_ls_state = ls.adjust(ls_state, finite)
+        state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt,
+            loss_scale_state=new_ls_state)
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+        loss = float(ls.unscale(ls_state, sloss))
+        finite = bool(finite)
+
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    assert 0.8 * ln_v <= loss <= 1.6 * ln_v, (
+        f"init loss {loss} implausible vs ln(V)={ln_v:.3f}")
+    peak_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    _emit({
+        "metric": "mistral_7b_tp8_sp_train_step_executed",
+        "value": 1,
+        "unit": "ok",
+        "executed": True,
+        "loss": round(loss, 4),
+        "loss_over_ln_vocab": round(loss / ln_v, 3),
+        "loss_plausibility_checked": "0.8 <= loss/ln(V) <= 1.6",
+        "grads_finite": finite,
+        "batch": b, "seq": s,
+        "host_cpu_step_seconds": round(dt, 1),
+        "host_cpu_grad_seconds": round(t_grads, 1),
+        "num_params": int(n_params),
+        "kv_heads_per_shard": cfg.kv_heads // mesh.shape["tensor"],
+        "mesh": dict(mesh.shape),
+        "host_peak_rss_bytes": int(peak_rss),
+        "two_phase_cpu_note": (
+            "grad jit + leaf-wise optimizer (XLA:CPU ignores donation "
+            "for sharded buffers; one-jit form exceeds host RAM at 7B "
+            "O2 x2 state — TPU runs the one-jit form)"),
+        "per_device_argument_bytes": getattr(
+            mem, "argument_size_in_bytes", None),
+        "per_device_temp_bytes": getattr(mem, "temp_size_in_bytes",
+                                         None),
+        "per_device_output_bytes": getattr(
+            mem, "output_size_in_bytes", None),
+    })
+
+
 # ----------------------------------------------------------------- BERT O1
 
 def bench_bert_o1():
@@ -644,6 +1032,140 @@ def bench_bert_o1():
     _emit(out)
 
 
+# ----------------------------------------------------------------- llama 1B
+
+def _llama_1b_cfg(variant):
+    """1.03B-param Llama recipe (d=128 heads — full MXU lanes):
+    hidden 2048 × 20 layers, GQA 16q/4kv, SwiGLU ffn 5632, RoPE,
+    RMSNorm, untied head, no linear biases.
+
+    Variants isolate the recipe's two levers (round-4 verdict item 1):
+    ``mha``  — kv heads = q heads (16), everything else equal: what
+               GQA buys (in training: qkv-proj params/flops + kv
+               bandwidth; the cache win shows in the decode bench).
+    ``gelu`` — ungated GELU MLP at ffn 8448 = iso-PARAM with the
+               gated 3-matrix SwiGLU (2·2048·8448 = 3·2048·5632):
+               what the SwiGLU structure costs at equal capacity.
+    """
+    import jax.numpy as jnp
+
+    from apex_tpu.models import LlamaConfig
+
+    kw = dict(vocab_size=32000, hidden_size=2048,
+              # full 20 layers by default; override for smoke tests
+              num_layers=int(os.environ.get("BENCH_LLAMA_LAYERS", "20")),
+              num_heads=16, num_kv_heads=4, ffn_hidden_size=5632,
+              max_seq_len=int(os.environ.get("BENCH_SEQ", "1024")),
+              dtype=jnp.bfloat16, remat=True, scan_layers=False)
+    if variant == "mha":
+        kw["num_kv_heads"] = 16
+    elif variant == "gelu":
+        kw.update(gated_mlp=False, activation="gelu",
+                  ffn_hidden_size=8448)
+    return LlamaConfig(**kw)
+
+
+def _llama_1b_single():
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.models import LlamaModel, gpt_loss_fn
+    from apex_tpu.optim import fused_adam
+
+    var = os.environ["BENCH_LLAMA_VARIANT"]
+    cfg = _llama_1b_cfg(var)
+    model = LlamaModel(cfg)
+    b = int(os.environ.get("BENCH_BATCH", "8"))
+    s = cfg.max_seq_len
+
+    ids = jax.random.randint(
+        jax.random.PRNGKey(0), (b, s + 1), 0, cfg.vocab_size, jnp.int32)
+    inputs, labels = ids[:, :-1], ids[:, 1:]
+    params = model.init(jax.random.PRNGKey(0), inputs[:2])
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    state = amp.initialize(
+        model.apply, params,
+        fused_adam(1e-4, moment_dtype=jnp.bfloat16),
+        opt_level="O2", half_dtype=jnp.bfloat16)
+
+    def loss_of(state, p, inputs, labels):
+        cp = state.policy.cast_to_compute(p)
+        logits = state.apply_fn(cp, inputs)
+        # bf16 logits straight into the fused CE (upcasts per-element)
+        loss = gpt_loss_fn(logits, labels)
+        return state.scale_loss(loss), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, inputs, labels):
+        grads, loss = jax.grad(
+            lambda p: loss_of(state, p, inputs, labels),
+            has_aux=True)(state.params)
+        new_state, finite = state.apply_gradients(grads=grads)
+        return new_state, loss, finite
+
+    @jax.jit
+    def fwd_only(state, inputs, labels):
+        return loss_of(state, state.params, inputs, labels)[1]
+
+    @jax.jit
+    def fwd_bwd(state, inputs, labels):
+        grads, loss = jax.grad(
+            lambda p: loss_of(state, p, inputs, labels),
+            has_aux=True)(state.params)
+        acc = loss
+        for g in jax.tree.leaves(grads):
+            acc = acc + g.ravel()[0].astype(loss.dtype)
+        return acc
+
+    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
+    k_windows = max(1, int(os.environ.get("BENCH_WINDOWS", "3")))
+    n_probe = max(n_steps // 2, 5)
+    t_fwd = bench._measure_fn(fwd_only, state, (inputs, labels),
+                              n_probe, k_windows)
+    t_fb = bench._measure_fn(fwd_bwd, state, (inputs, labels),
+                             n_probe, k_windows)
+    out = _measure(state, step, (inputs, labels), b,
+                   {"batch": b, "seq": s, "variant": var,
+                    "num_params": int(n_params),
+                    "fwd_ms": round(t_fwd * 1e3, 2),
+                    "bwd_ms": round(max(t_fb - t_fwd, 0.0) * 1e3, 2)})
+    out["opt_ms"] = round(max(out["step_ms"] / 1e3 - t_fb, 0.0) * 1e3, 2)
+    out["tokens_per_sec"] = round(out["value"] * s, 1)
+    out["metric"] = f"llama_1b_{var}_O2_fusedadam_samples_per_sec_per_chip"
+    _emit(out)
+
+
+def bench_llama_1b():
+    """The Llama recipe on the scoreboard (round-4 verdict item 1):
+    1.03B GQA+SwiGLU+RMSNorm+RoPE, O2+FusedAdam, measured on-chip with
+    fwd/bwd/opt split and roofline self-check, plus the two A/B rows
+    (GQA vs MHA; SwiGLU vs iso-param GELU).  One fresh process per
+    variant (HBM not reclaimed promptly across builds)."""
+    if os.environ.get("BENCH_LLAMA_VARIANT"):
+        _llama_1b_single()
+        return
+    rows = {}
+    for var in ("gqa", "mha", "gelu"):
+        rows[var] = _run_child(
+            "llama_1b", {"BENCH_LLAMA_VARIANT": var}, timeout=2400)
+    main = dict(rows.get("gqa") or {})
+    ab = {}
+    if rows.get("mha", {}).get("value") and main.get("value"):
+        ab["gqa_vs_mha_speedup"] = round(
+            main["value"] / rows["mha"]["value"], 3)
+    if rows.get("gelu", {}).get("value") and main.get("value"):
+        ab["swiglu_vs_gelu_iso_param_speedup"] = round(
+            main["value"] / rows["gelu"]["value"], 3)
+    _emit({
+        "metric": "llama_1b_pretrain_O2_fusedadam_samples_per_sec_per_chip",
+        "value": main.get("value"),
+        "unit": "samples/sec/chip",
+        "rows": rows,
+        "ab": ab,
+    })
+
+
 # ----------------------------------------------------------------- long ctx
 
 def bench_long_context():
@@ -663,24 +1185,11 @@ def bench_long_context():
         # the (32768, 4096) row is Mistral-style sliding-window: the
         # banded kernel grid pays only window/seq of full attention
         for s, w in ((8192, 0), (16384, 0), (32768, 0), (32768, 4096)):
-            env = dict(os.environ)
-            env["BENCH_LC_SINGLE"] = "1"
-            env["BENCH_SEQ"] = str(s)
-            env["BENCH_WINDOW"] = str(w)
             key = f"{s}w{w}" if w else str(s)
-            try:
-                proc = subprocess.run(
-                    [sys.executable, __file__, "long_context"], env=env,
-                    capture_output=True, text=True, timeout=1500)
-            except subprocess.TimeoutExpired:
-                # record and keep the rows already measured
-                rows[key] = {"error": "timeout after 1500s"}
-                continue
-            lines = [l for l in proc.stdout.splitlines()
-                     if l.startswith("{")]
-            rows[key] = (json.loads(lines[-1]) if lines and
-                         proc.returncode == 0 else
-                         {"error": (proc.stderr or "?")[-800:]})
+            rows[key] = _run_child(
+                "long_context",
+                {"BENCH_LC_SINGLE": "1", "BENCH_SEQ": str(s),
+                 "BENCH_WINDOW": str(w)}, timeout=1500)
         out8 = dict(rows.get("8192") or {})
         out8.pop("metric", None)
         _emit({
@@ -738,21 +1247,38 @@ def _long_context_single():
         new_state, finite = state.apply_gradients(grads=grads)
         return new_state, loss, finite
 
-    # at 16k+ the step is dominated by the d=64 flash kernels, whose
-    # measured achievable rate is ~93 TFLOP/s (tools/attn_bench.py,
-    # s=32k fwd+bwd useful-flops; the irreducible MXU contraction
-    # padding at d=64 caps it well below chip peak) — give the
-    # roofline self-check that bound so contention_suspect means
-    # contention, not "this kernel class can't reach 197 TFLOP/s"
-    # (round-3 verdict weak #4).  At 8k attention is a minor fraction
-    # of the flops, so the chip-peak bound stays authoritative there.
-    # the windowed kernel's own measured ceiling is ~70 TFLOP/s on
-    # useful (in-band) flops — band-edge tiles under-fill the row
-    # pipeline relative to the full triangle's 93 (tools/attn_bench.py)
-    out = _measure(state, step, (inputs, labels), b,
-                   {"batch": b, "seq": s, "window": w},
-                   measured_tflops=(70.0 if w else 93.0)
-                   if s >= 16384 else None)
+    # Uniform phase-sum bound for the whole ladder (round-4 verdict
+    # weak #2 — and a round-5 correction: XLA's cost model reports
+    # flops=None for Pallas custom calls, so the round-4 "kernel-own
+    # bound" 16k/32k rows were accidentally scoring the bound on the
+    # NON-attention remainder only).  The flash kernels' work is
+    # accounted analytically — tools/attn_bench.py's useful-flop
+    # units: one tile-matmul = 2·b·h·visible_pairs·d; per step the
+    # kernels run 11 units (fwd 2 + remat re-fwd 2 + dq 3 + dkv 4;
+    # remat=True with nothing_saveable re-runs the forward kernel in
+    # the backward) — at the kernel family's MEASURED achievable rate
+    # (93 TFLOP/s full-causal, 70 windowed, tools/attn_bench.py: the
+    # d=64 contraction padding caps it below chip peak).
+    ww = min(w or s, s)
+    pairs = (ww - 1) * ww / 2 + (s - ww + 1) * ww
+    unit = 2 * b * cfg.num_heads * pairs * cfg.head_dim
+    attn_flops = 11 * unit * cfg.num_layers
+    attn_rate = (70.0 if w else 93.0) * 1e12
+    # kernel I/O visible to XLA (deducted from its bytes-accessed so
+    # the phase-sum bound never counts this traffic twice): per layer
+    # per step — fwd×2 (remat re-run) reads q,k,v + writes o,lse;
+    # dq reads q,k,v,do,lse,delta + writes dq; dkv reads the same +
+    # writes dk,dv → 19 (b,s,h,d)-sized bf16 passes + 6 lse/delta f32
+    io = b * s * cfg.num_heads * cfg.head_dim * 2
+    lse_io = b * s * cfg.num_heads * 4
+    attn_xla_bytes = cfg.num_layers * (19 * io + 6 * lse_io)
+    out = _measure(
+        state, step, (inputs, labels), b,
+        {"batch": b, "seq": s, "window": w},
+        phase_bounds=[{"name": "flash_attention_fwd_bwd",
+                       "seconds": attn_flops / attn_rate,
+                       "flops": attn_flops,
+                       "xla_bytes": attn_xla_bytes}])
     out["tokens_per_sec"] = round(out["value"] * s, 1)
 
     if s == 8192:
@@ -782,6 +1308,165 @@ def _long_context_single():
     tag = f"{s//1024}k" + (f"_swa{w//1024}k" if w else "")
     out["metric"] = f"gpt_long_context_{tag}_O2_samples_per_sec_per_chip"
     _emit(out)
+
+
+# ----------------------------------------------------------------- decode
+
+def _decode_single():
+    """One (batch, max_seq_len, attn-impl) decode measurement: prefill
+    tokens/s + steady-state per-token decode latency on the llama_1b
+    GQA model, with a bytes/token roofline (decode is the canonical
+    HBM-bound workload: every token reads all params + the KV cache)."""
+    import dataclasses
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models import LlamaModel, init_cache
+
+    b = int(os.environ["BENCH_DECODE_BATCH"])
+    S = int(os.environ["BENCH_DECODE_MAXLEN"])
+    P = int(os.environ.get("BENCH_DECODE_PROMPT", "1024"))
+    N = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
+    cfg = dataclasses.replace(_llama_1b_cfg("gqa"), max_seq_len=S)
+    model = LlamaModel(cfg)
+
+    ids = jax.random.randint(
+        jax.random.PRNGKey(0), (b, P), 0, cfg.vocab_size, jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:1, :8])
+    # inference: bf16 params (the O2 compute copy; no masters needed)
+    params = {"params": jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        params["params"])}
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    cache = init_cache(model, b)
+
+    def apply(params, cache, ids):
+        logits, upd = model.apply(
+            {**params, "cache": cache}, ids, deterministic=True,
+            decode=True, mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                         axis=-1).astype(jnp.int32)
+        return nxt, upd["cache"]
+
+    prefill = jax.jit(apply)
+
+    @jax.jit
+    def decode_n(params, cache, tok):
+        def step(carry, _):
+            cache, tok = carry
+            nxt, cache = apply(params, cache, tok[:, None])
+            return (cache, nxt), None
+
+        (cache, tok), _ = jax.lax.scan(step, (cache, tok), None,
+                                       length=N)
+        return tok
+
+    tok, filled = prefill(params, cache, ids)          # warm + fill
+    bench._sync(tok)
+    dec_c = bench._aot_compile(decode_n, params, filled, tok)
+    dec = dec_c if dec_c is not None else decode_n
+    bench._sync(dec(params, filled, tok))
+    ovh = bench._call_overhead()
+    k_windows = max(1, int(os.environ.get("BENCH_WINDOWS", "3")))
+
+    reps = 5
+
+    def prefill_window():
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            nxt, _f = prefill(params, cache, ids)
+        bench._sync(nxt)
+        return (time.perf_counter() - t0 - ovh) / reps
+
+    t_pre, pre_w = bench._time_windows(prefill_window, k_windows)
+
+    def decode_window():
+        t0 = time.perf_counter()
+        for _ in range(2):
+            out = dec(params, filled, tok)
+        bench._sync(out)
+        return (time.perf_counter() - t0 - ovh) / 2
+
+    t_dec, dec_w = bench._time_windows(decode_window, k_windows)
+    t_tok = t_dec / N
+
+    # bytes/token roofline: params once + KV (k and v) per layer, bf16.
+    # 'full' = the whole (b, S, hk, d) cache (what the one-shot einsum
+    # reads); 'live' = the filled prefix P..P+N only (what the blocked
+    # skip bounds reads to).
+    kvb = cfg.num_layers * b * cfg.kv_heads * cfg.head_dim * 2 * 2
+    bytes_full = 2 * n_params + kvb * S
+    bytes_live = 2 * n_params + kvb * (P + N // 2)
+    out = {
+        "batch": b, "max_seq_len": S, "prompt": P,
+        "decode_attn": os.environ.get("APEX_TPU_DECODE_ATTN", "auto"),
+        "num_params": int(n_params),
+        "prefill_tokens_per_sec": round(b * P / t_pre, 1),
+        "prefill_ms": round(t_pre * 1e3, 2),
+        "prefill_window_ms": [round(d * 1e3, 2) for d in pre_w],
+        "decode_tokens_per_sec": round(b / t_tok, 1),
+        "decode_ms_per_token": round(t_tok * 1e3, 3),
+        "decode_window_ms": [round(d * 1e3, 2) for d in dec_w],
+        "bytes_per_token_model": {
+            "params": 2 * n_params, "kv_full_cache": kvb * S,
+            "kv_live": kvb * (P + N // 2)},
+        "achieved_gbs_vs_full_read": round(
+            bytes_full / t_tok / 1e9, 1),
+        "achieved_gbs_vs_live_read": round(
+            bytes_live / t_tok / 1e9, 1),
+        "frac_of_peak_hbm_live": round(
+            bytes_live / t_tok / 1e9 / bench._PEAK_HBM_GBS, 3),
+    }
+    if dec_c is not None:
+        try:
+            ca = dec_c.cost_analysis() or {}
+            byts = float(ca.get("bytes accessed", 0.0))
+            if byts:
+                out["cost_bytes_per_token"] = round(byts / N, 1)
+        except Exception:
+            pass
+    out["metric"] = f"llama1b_decode_b{b}_S{S}"
+    _emit(out)
+
+
+def bench_decode():
+    """Generation scoreboard (round-4 verdict item 2a): prefill +
+    steady-state decode throughput of the llama_1b recipe at
+    b ∈ {1, 8, 32}, plus the full-vs-live cache-read A/B (the dense
+    einsum reads all max_seq_len slots every token; the blocked form
+    skips dead blocks) at 2k and 8k cache sizes."""
+    if os.environ.get("BENCH_DECODE_BATCH"):
+        _decode_single()
+        return
+    runs = [
+        ("b1_S2048", {"BENCH_DECODE_BATCH": "1",
+                      "BENCH_DECODE_MAXLEN": "2048"}),
+        ("b8_S2048", {"BENCH_DECODE_BATCH": "8",
+                      "BENCH_DECODE_MAXLEN": "2048"}),
+        ("b32_S2048", {"BENCH_DECODE_BATCH": "32",
+                       "BENCH_DECODE_MAXLEN": "2048"}),
+        ("b8_S2048_blocked", {"BENCH_DECODE_BATCH": "8",
+                              "BENCH_DECODE_MAXLEN": "2048",
+                              "APEX_TPU_DECODE_ATTN": "blocked"}),
+        ("b8_S8192", {"BENCH_DECODE_BATCH": "8",
+                      "BENCH_DECODE_MAXLEN": "8192"}),
+        ("b8_S8192_einsum", {"BENCH_DECODE_BATCH": "8",
+                             "BENCH_DECODE_MAXLEN": "8192",
+                             "APEX_TPU_DECODE_ATTN": "einsum"}),
+    ]
+    rows = {}
+    for key, env_kw in runs:
+        rows[key] = _run_child("decode", env_kw, timeout=1500)
+    head = rows.get("b8_S2048") or {}
+    _emit({
+        "metric": "llama1b_decode_tokens_per_sec",
+        "value": head.get("decode_tokens_per_sec"),
+        "unit": "tokens/sec (b=8, S=2048)",
+        "rows": rows,
+    })
 
 
 # ----------------------------------------------------------------- ViT-Huge
@@ -929,42 +1614,45 @@ LEGS = {
     "gpt2_1p3b": bench_gpt2_1p3b,
     "gpt2_tp8_full_step": bench_gpt2_tp8_full_step,
     "gpt2_3d_full_step": bench_gpt2_3d_full_step,
+    "mistral7b_tp8_full_step": bench_mistral7b_tp8_full_step,
+    "llama_1b": bench_llama_1b,
+    "decode": bench_decode,
     "vit_huge_lamb": bench_vit_huge_lamb,
     "long_context": bench_long_context,
     "group_norm": bench_group_norm,
 }
 
 # legs that must run on the virtual CPU mesh, not the real chip
-_CPU_LEGS = {"gpt2_tp8_full_step", "gpt2_3d_full_step"}
+_CPU_LEGS = {"gpt2_tp8_full_step", "gpt2_3d_full_step",
+             "mistral7b_tp8_full_step"}
+
+
+# per-leg timeouts: orchestrator legs must outlast the sum of their
+# own children's budgets (a parent timeout would discard every
+# already-measured child row)
+_LEG_TIMEOUT = {"decode": 10000, "llama_1b": 8000,
+                "long_context": 6600}
 
 
 def _run_all():
     results = {}
     for name in LEGS:
-        env = dict(os.environ)
+        env = {}
         if name in _CPU_LEGS:
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (
-                env.get("XLA_FLAGS", "")
-                + " --xla_force_host_platform_device_count=8").strip()
+            env = {"JAX_PLATFORMS": "cpu",
+                   "PALLAS_AXON_POOL_IPS": None,
+                   "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                                 + " --xla_force_host_platform_device"
+                                   "_count=8").strip()}
         print(f"== {name}", file=sys.stderr)
-        try:
-            proc = subprocess.run(
-                [sys.executable, __file__, name], env=env,
-                capture_output=True, text=True, timeout=5400)
-        except subprocess.TimeoutExpired:
-            results[name] = {"error": "timeout after 5400s"}
-            print("  FAILED: timeout", file=sys.stderr)
-            continue
-        line = [l for l in proc.stdout.splitlines() if l.startswith("{")]
-        if proc.returncode != 0 or not line:
-            results[name] = {"error": (proc.stderr or proc.stdout)[-2000:]}
+        results[name] = _run_child(
+            name, env, timeout=_LEG_TIMEOUT.get(name, 5400))
+        if "error" in results[name]:
             print(f"  FAILED: {results[name]['error'][-300:]}",
                   file=sys.stderr)
         else:
-            results[name] = json.loads(line[-1])
-            print(f"  {line[-1]}", file=sys.stderr)
+            print(f"  {json.dumps(results[name])[:400]}",
+                  file=sys.stderr)
     with open("BENCH_CONFIGS.json", "w") as f:
         json.dump(results, f, indent=2)
     print(json.dumps({"legs": {k: v.get("value") for k, v in
