@@ -391,14 +391,14 @@ class ParallelAttention(nn.Module):
                     # steady decode reads the WHOLE (b, S, hk, d) cache
                     # every token in the one-shot einsum; the blocked
                     # form's lax.cond skip bounds reads to the live
-                    # prefix — a real-bandwidth win once the cache is
-                    # long (measured in the decode bench: BASELINE.md
-                    # round-5 decode section), so it is the default
-                    # from 4096 slots up.  APEX_TPU_DECODE_ATTN
+                    # prefix — measured on-chip (decode bench,
+                    # BASELINE.md round-5): +30% tokens/s at S=2048
+                    # and 2.3x at S=8192 (b=8, llama_1b), so it is the
+                    # default from 2048 slots up.  APEX_TPU_DECODE_ATTN
                     # ∈ {einsum, blocked} overrides for A/B.
                     mode = os.environ.get("APEX_TPU_DECODE_ATTN", "auto")
                     if mode == "blocked" or (
-                            mode == "auto" and S >= 4096):
+                            mode == "auto" and S >= 2048):
                         o = _cache_attention_blocked(
                             q, keys, values, idx, scale, block=512)
                     else:

@@ -141,14 +141,21 @@ def _build(cfg_kw, opt_level, half_dtype, fused):
         grads, loss = jax.grad(
             lambda p_: loss_of(state, p_, ids, positions, mlm_labels),
             has_aux=True)(state.params)
-        # reduce grads to one scalar so the probe's output transfer is
-        # O(1) but still depends on every gradient leaf
-        acc = loss
-        for g in jax.tree.leaves(grads):
-            acc = acc + g.ravel()[0].astype(loss.dtype)
-        return acc
+        return _probe_reduce(grads, loss)
 
     return state, step, (fwd_only, fwd_bwd), (ids, positions, mlm_labels), b
+
+
+def _probe_reduce(grads, loss):
+    """Reduce a grad tree to one scalar so a fwd+bwd probe's output
+    transfer is O(1) but still depends on every gradient leaf (an
+    unused leaf's producing computation would be DCE'd)."""
+    import jax
+
+    acc = loss
+    for g in jax.tree.leaves(grads):
+        acc = acc + g.ravel()[0].astype(loss.dtype)
+    return acc
 
 
 def _sync(x):

@@ -132,9 +132,14 @@ class TestForward:
                 return jnp.sum(o * o)
             return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
+        # On real TPU both sides' f32 matmuls run default-precision
+        # MXU passes (~4e-3 relative each, in different directions);
+        # kernel ≡ interpret stays 2e-7 there (round-5 on-chip run),
+        # so the loose tier checks implementations, not MXU rounding.
+        tol = (1e-4 if jax.default_backend() == "cpu" else 3e-2)
         for g, w, name in zip(f("pallas_interpret"), f("xla"), "qkv"):
             np.testing.assert_allclose(np.asarray(g), np.asarray(w),
-                                       rtol=1e-4, atol=1e-4,
+                                       rtol=tol, atol=tol,
                                        err_msg=name)
 
     def test_learned_bias_requires_grad_routes_to_xla(self, rng):

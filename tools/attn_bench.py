@@ -14,7 +14,10 @@ iterating inside one jit (lax.scan) and subtracting the measured
 trivial-call overhead.
 
 Usage:
-    python tools/attn_bench.py [s=32768] [d=64] [h=8] [b=1] [iters=8]
+    python tools/attn_bench.py [s=32768] [d=64] [h=8] [hk=0] [b=1]
+                               [iters=8] [window=0]
+(``hk``: GQA kv heads, 0 = MHA; flops are counted per q-head, so GQA
+rates are directly comparable with MHA rows.)
 """
 
 from __future__ import annotations
@@ -68,20 +71,21 @@ def measure(fn, args, iters, overhead, windows=3):
 
 
 def main():
-    kw = dict(s=32768, d=64, h=8, b=1, iters=8, window=0)
+    kw = dict(s=32768, d=64, h=8, hk=0, b=1, iters=8, window=0)
     for a in sys.argv[1:]:
         k, v = a.split("=")
         kw[k] = int(v)
     s, d, h, b, iters = (kw[k] for k in ("s", "d", "h", "b", "iters"))
     window = kw["window"] or None
+    hk = kw["hk"] or h                   # GQA: fewer kv heads
 
     from apex_tpu.ops.attention import fused_attention
 
     q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d),
                           jnp.bfloat16)
-    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d),
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hk, d),
                           jnp.bfloat16)
-    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d),
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hk, d),
                           jnp.bfloat16)
 
     def fwd(q, k, v):
@@ -105,7 +109,7 @@ def main():
     pairs = (w - 1) * w / 2 + (s - w + 1) * w     # sum_q min(q+1, w)
     unit = 2 * b * h * pairs * d                  # one tile-matmul
     print(json.dumps({
-        "b": b, "s": s, "h": h, "d": d, "window": window,
+        "b": b, "s": s, "h": h, "hk": hk, "d": d, "window": window,
         "call_overhead_ms": round(overhead * 1e3, 1),
         "fwd_ms": round(dt_f * 1e3, 2),
         "fwd_tflops": round(2 * unit / dt_f / 1e12, 2),

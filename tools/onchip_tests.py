@@ -1,0 +1,79 @@
+"""Run the kernel test subset on the REAL TPU chip and record the
+result as a repo artifact (round-4 verdict weak #3: interpret-mode CI
+cannot catch Mosaic-only miscompiles — e.g. the round-3 GroupNorm
+sequential-grid assumption — so each round records one on-chip pass).
+
+The subset is the Pallas-kernel golden suites (attention / layer norm /
+ops / optim incl. the fp8-Adam kernel) — the tests whose CPU runs go
+through interpret mode and therefore prove nothing about Mosaic
+compilation.  Distributed/mesh suites stay CPU-only (one real chip).
+
+Usage:  python tools/onchip_tests.py          # writes ONCHIP_r{N}.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+SUBSET = [
+    "tests/test_attention.py",
+    "tests/test_layer_norm.py",
+    "tests/test_ops.py",
+    "tests/test_optim.py",
+]
+
+
+def main():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["APEX_TPU_TEST_PLATFORM"] = os.environ.get(
+        "APEX_TPU_TEST_PLATFORM", "axon")
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", *SUBSET, "-q"],
+        cwd=root, env=env, capture_output=True, text=True,
+        timeout=7200)
+    dt = time.time() - t0
+    tail = (proc.stdout or "").strip().splitlines()[-1:] or [""]
+    m = re.search(r"(\d+) passed", tail[0])
+    failed = re.search(r"(\d+) failed", tail[0])
+    import jax
+
+    out = {
+        "artifact": "on-chip kernel test pass",
+        "platform_env": env["APEX_TPU_TEST_PLATFORM"],
+        "result_line": tail[0],
+        "passed": int(m.group(1)) if m else 0,
+        "failed": int(failed.group(1)) if failed else 0,
+        "returncode": proc.returncode,
+        "wall_seconds": round(dt, 1),
+        "jax": jax.__version__,
+        "libtpu": _libtpu_version(),
+        "date": time.strftime("%Y-%m-%d"),
+        "subset": SUBSET,
+    }
+    name = os.environ.get("ONCHIP_ARTIFACT", "ONCHIP_r05.json")
+    with open(os.path.join(root, name), "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    if proc.returncode != 0:
+        print(proc.stdout[-3000:], file=sys.stderr)
+        sys.exit(1)
+
+
+def _libtpu_version():
+    try:
+        import importlib.metadata as md
+
+        return md.version("libtpu")
+    except Exception:
+        return None
+
+
+if __name__ == "__main__":
+    main()
