@@ -642,3 +642,35 @@ class TestGPT2SliceTP8:
                     atol=5e-5, err_msg=str(ka))
         finally:
             mesh_lib.destroy_mesh()
+
+
+class TestLlamaPresets:
+    """Config presets are API surface: geometry invariants asserted so
+    a preset edit can't silently break TP divisibility or GQA."""
+
+    @pytest.mark.l0
+    def test_preset_geometry(self):
+        from apex_tpu.models import LlamaConfig
+
+        for name in ("llama_1b", "llama2_7b", "mistral_7b", "llama3_8b"):
+            cfg = getattr(LlamaConfig, name)()
+            assert cfg.hidden_size % cfg.num_heads == 0, name
+            assert cfg.num_heads % cfg.kv_heads == 0, name
+            assert cfg.norm == "rmsnorm" and cfg.gated_mlp, name
+            assert not cfg.add_bias_linear and not cfg.tie_embeddings
+            # kv heads shard over TP=8 (divisible or fully replicable)
+            assert cfg.kv_heads % 8 == 0 or 8 % cfg.kv_heads == 0, name
+
+    def test_llama_1b_param_count(self):
+        """The scoreboard recipe is ~1.03B params as documented."""
+        import jax
+
+        from apex_tpu.models import LlamaConfig, LlamaModel
+
+        cfg = LlamaConfig.llama_1b(scan_layers=True)
+        model = LlamaModel(cfg)
+        shapes = jax.eval_shape(
+            model.init, jax.random.PRNGKey(0),
+            jax.ShapeDtypeStruct((1, 8), jnp.int32))
+        n = sum(x.size for x in jax.tree.leaves(shapes))
+        assert 1.02e9 < n < 1.05e9, n
