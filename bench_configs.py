@@ -1187,13 +1187,50 @@ def _llama_1b_single():
         loss = gpt_loss_fn(logits, labels)
         return state.scale_loss(loss), loss
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(state, inputs, labels):
-        grads, loss = jax.grad(
-            lambda p: loss_of(state, p, inputs, labels),
-            has_aux=True)(state.params)
-        new_state, finite = state.apply_gradients(grads=grads)
-        return new_state, loss, finite
+    # BENCH_ACCUM > 1: gradient accumulation over microbatches of
+    # b/accum (set BENCH_BATCH to the GLOBAL batch — e.g. the measured
+    # negative in BASELINE.md is BENCH_BATCH=8 BENCH_ACCUM=2) — the
+    # amortization lever the round-5 overlap experiment points at
+    # (optimizer/master streaming can't overlap more, but it CAN run
+    # once per accum fwd+bwds; the single-shot b is HBM-capped at 4)
+    accum = int(os.environ.get("BENCH_ACCUM", "1"))
+    if b % accum:
+        raise ValueError(
+            f"BENCH_BATCH ({b}) must be divisible by BENCH_ACCUM "
+            f"({accum})")
+    if accum > 1:
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, inputs, labels):
+            mbs = jax.tree.map(
+                lambda x: x.reshape(accum, x.shape[0] // accum,
+                                    *x.shape[1:]), (inputs, labels))
+
+            def body(acc, mb):
+                g, l = jax.grad(
+                    lambda p: loss_of(state, p, *mb),
+                    has_aux=True)(state.params)
+                acc_g, acc_l = acc
+                return (jax.tree.map(
+                    lambda a, gg: a + gg.astype(a.dtype), acc_g, g),
+                    acc_l + l), None
+
+            # bf16 accumulator: the fp32 one costs an extra 2 GB that
+            # OOMs this chip; grads feed bf16 moments downstream anyway
+            zero = (jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.bfloat16),
+                state.params), jnp.zeros((), jnp.float32))
+            (gsum, lsum), _ = jax.lax.scan(body, zero, mbs)
+            grads = jax.tree.map(lambda g: g / accum, gsum)
+            new_state, finite = state.apply_gradients(grads=grads)
+            return new_state, lsum / accum, finite
+    else:
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, inputs, labels):
+            grads, loss = jax.grad(
+                lambda p: loss_of(state, p, inputs, labels),
+                has_aux=True)(state.params)
+            new_state, finite = state.apply_gradients(grads=grads)
+            return new_state, loss, finite
 
     @jax.jit
     def fwd_only(state, inputs, labels):
@@ -1209,16 +1246,21 @@ def _llama_1b_single():
     n_steps = int(os.environ.get("BENCH_STEPS", "20"))
     k_windows = max(1, int(os.environ.get("BENCH_WINDOWS", "3")))
     n_probe = max(n_steps // 2, 5)
-    t_fwd = bench._measure_fn(fwd_only, state, (inputs, labels),
-                              n_probe, k_windows)
-    t_fb = bench._measure_fn(fwd_bwd, state, (inputs, labels),
-                             n_probe, k_windows)
-    out = _measure(state, step, (inputs, labels), b,
-                   {"batch": b, "seq": s, "variant": var,
-                    "num_params": int(n_params),
-                    "fwd_ms": round(t_fwd * 1e3, 2),
-                    "bwd_ms": round(max(t_fb - t_fwd, 0.0) * 1e3, 2)})
-    out["opt_ms"] = round(max(out["step_ms"] / 1e3 - t_fb, 0.0) * 1e3, 2)
+    extra = {"batch": b, "seq": s, "variant": var, "accum": accum,
+             "num_params": int(n_params)}
+    if accum == 1:
+        # probes run the whole global batch in one fwd/bwd — only
+        # meaningful (and HBM-feasible) without accumulation
+        t_fwd = bench._measure_fn(fwd_only, state, (inputs, labels),
+                                  n_probe, k_windows)
+        t_fb = bench._measure_fn(fwd_bwd, state, (inputs, labels),
+                                 n_probe, k_windows)
+        extra["fwd_ms"] = round(t_fwd * 1e3, 2)
+        extra["bwd_ms"] = round(max(t_fb - t_fwd, 0.0) * 1e3, 2)
+    out = _measure(state, step, (inputs, labels), b, extra)
+    if accum == 1:
+        out["opt_ms"] = round(
+            max(out["step_ms"] / 1e3 - t_fb, 0.0) * 1e3, 2)
     out["tokens_per_sec"] = round(out["value"] * s, 1)
     out["metric"] = f"llama_1b_{var}_O2_fusedadam_samples_per_sec_per_chip"
     _emit(out)
