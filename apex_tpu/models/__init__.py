@@ -13,7 +13,8 @@ from apex_tpu.models.transformer import (
     ParallelAttention,
     ParallelMLP,
 )
-from apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
+from apex_tpu.models.gpt import (GPTConfig, GPTModel, gpt_loss_fn,
+                                 moe_aux_loss)
 from apex_tpu.models.llama import LlamaConfig, LlamaModel
 from apex_tpu.models.bert import BertConfig, BertModel, bert_mlm_loss_fn
 from apex_tpu.models.resnet import ResNetConfig, ResNet, resnet50, resnet18
@@ -30,6 +31,7 @@ __all__ = [
     "GPTConfig",
     "GPTModel",
     "gpt_loss_fn",
+    "moe_aux_loss",
     "LlamaConfig",
     "LlamaModel",
     "BertConfig",

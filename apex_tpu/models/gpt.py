@@ -32,7 +32,7 @@ from apex_tpu.transformer.layers import (
     maybe_constrain,
 )
 
-__all__ = ["GPTConfig", "GPTModel", "gpt_loss_fn"]
+__all__ = ["GPTConfig", "GPTModel", "gpt_loss_fn", "moe_aux_loss"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,3 +112,23 @@ def gpt_loss_fn(logits, labels, *, ignore_index: int = -100):
     """Next-token CE averaged over valid tokens (memory-saving
     xentropy, fp32)."""
     return mean_cross_entropy(logits, labels, ignore_index=ignore_index)
+
+
+def moe_aux_loss(mutated_variables) -> jnp.ndarray:
+    """Sum the per-layer MoE load-balance terms a model sowed into the
+    ``losses`` collection.
+
+    Usage with ``num_moe_experts`` configs::
+
+        logits, mut = model.apply(params, ids, mutable=["losses"])
+        loss = gpt_loss_fn(logits, labels) + moe_aux_loss(mut)
+
+    Each term already carries its ``moe_aux_loss_weight``; a model
+    without MoE layers (or applied without ``mutable=["losses"]``)
+    contributes 0.
+    """
+    leaves = jax.tree.leaves(dict(mutated_variables).get("losses", {}))
+    total = jnp.asarray(0.0, jnp.float32)
+    for leaf in leaves:
+        total = total + jnp.sum(leaf.astype(jnp.float32))
+    return total

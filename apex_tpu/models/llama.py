@@ -100,6 +100,17 @@ class LlamaConfig(GPTConfig):
         return cls(**kw)
 
     @classmethod
+    def mixtral_8x7b(cls, **kw) -> "LlamaConfig":
+        """Mixtral-8x7B: the mistral_7b recipe with every dense MLP
+        replaced by 8 SwiGLU experts under top-2 token-choice routing
+        (sliding window included).  Apply with ``mutable=["losses"]``
+        and add :func:`~apex_tpu.models.moe_aux_loss` to the task
+        loss."""
+        kw.setdefault("num_moe_experts", 8)
+        kw.setdefault("moe_top_k", 2)
+        return cls.mistral_7b(**kw)
+
+    @classmethod
     def llama3_8b(cls, **kw) -> "LlamaConfig":
         """GQA sizing (8 kv heads), 128k vocab, rope theta 5e5."""
         kw.setdefault("layernorm_eps", 1e-5)

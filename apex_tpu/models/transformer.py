@@ -76,6 +76,20 @@ class TransformerConfig:
     # and up projections are separate ColumnParallel weights sharded
     # identically, so the elementwise product stays shard-local under TP.
     gated_mlp: bool = False
+    # Mixture-of-Experts FFN (Mixtral-style; beyond-reference — the
+    # reference has no EP, SURVEY §2.6 checklist): replaces every
+    # layer's dense MLP with `num_moe_experts` experts under top-k
+    # token-choice routing (transformer/moe.py — capacity-bounded
+    # GShard dispatch; experts shard over `moe_expert_axis` and GSPMD
+    # inserts the token all-to-all).  The per-layer load-balance aux
+    # loss is sown into the "losses" collection: apply with
+    # mutable=["losses"] and add `models.moe_aux_loss(mutated)` to the
+    # task loss.  gated_mlp/activation apply to the experts too.
+    num_moe_experts: Optional[int] = None
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 1e-2
+    moe_expert_axis: Optional[str] = TENSOR_AXIS
     # parallel / compile behavior
     sequence_parallel: bool = False
     remat: bool = False
@@ -141,6 +155,15 @@ class TransformerConfig:
                 raise ValueError(
                     f"sliding_window must be >= 1, got "
                     f"{self.sliding_window}")
+        if self.num_moe_experts is not None:
+            if self.num_moe_experts < 2:
+                raise ValueError(
+                    f"num_moe_experts must be >= 2, got "
+                    f"{self.num_moe_experts}")
+            if self.moe_top_k > self.num_moe_experts:
+                raise ValueError(
+                    f"moe_top_k ({self.moe_top_k}) cannot exceed "
+                    f"num_moe_experts ({self.num_moe_experts})")
 
 
 def _remat_policy(spec: str):
@@ -540,7 +563,31 @@ class ParallelTransformerLayer(nn.Module):
             a = nn.Dropout(rate=cfg.hidden_dropout)(a, deterministic=False)
         x = x + a.astype(x.dtype)
         m = _norm(cfg, "post_attention_norm")(x)
-        m = ParallelMLP(cfg, name="mlp")(m)
+        if cfg.num_moe_experts:
+            from apex_tpu.transformer.moe import MoEConfig, MoEMLP
+
+            m, aux = MoEMLP(MoEConfig(
+                num_experts=cfg.num_moe_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                hidden_size=cfg.hidden_size,
+                ffn_hidden_size=cfg.ffn_size,
+                activation=cfg.activation, gated=cfg.gated_mlp,
+                expert_axis=cfg.moe_expert_axis,
+                aux_loss_weight=cfg.moe_aux_loss_weight,
+                use_bias=cfg.add_bias_linear,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype),
+                name="moe_mlp")(m)
+            # load-balance aux term: a no-op unless the caller applies
+            # with mutable=["losses"] (flax drops sows into immutable
+            # collections) — models.moe_aux_loss sums them.  Never sown
+            # during init: a "losses" leaf in the init dict would ride
+            # into optimizer state / checkpoints and double-count on
+            # the first apply.
+            if not self.is_initializing():
+                self.sow("losses", "moe_aux", aux)
+        else:
+            m = ParallelMLP(cfg, name="mlp")(m)
         if cfg.hidden_dropout > 0.0 and not deterministic:
             m = nn.Dropout(rate=cfg.hidden_dropout)(m, deterministic=False)
         x = x + m.astype(x.dtype)
@@ -586,7 +633,7 @@ class ParallelTransformer(nn.Module):
                     policy=_remat_policy(cfg.remat_policy))
             stack = nn.scan(
                 block_cls,
-                variable_axes={"params": 0, "cache": 0},
+                variable_axes={"params": 0, "cache": 0, "losses": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=nn.broadcast,
                 length=cfg.num_layers,

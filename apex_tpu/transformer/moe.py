@@ -43,6 +43,12 @@ class MoEConfig:
     hidden_size: int = 1024
     ffn_hidden_size: Optional[int] = None
     activation: str = "gelu"
+    # gated-linear-unit experts (SwiGLU when activation="silu") — the
+    # Mixtral expert shape: act(x·w1) * (x·wg) -> w2
+    gated: bool = False
+    # expert biases (b1/b2); False for the bias-free Llama/Mixtral
+    # recipes (plumbed from TransformerConfig.add_bias_linear)
+    use_bias: bool = True
     expert_axis: Optional[str] = TENSOR_AXIS
     aux_loss_weight: float = 1e-2
     dtype: Any = jnp.float32
@@ -147,32 +153,47 @@ class MoEMLP(nn.Module):
             "w1", part(nn.initializers.he_normal(),
                        (cfg.expert_axis, None, None)),
             (e, h, cfg.ffn_size), cfg.param_dtype)
-        b1 = self.param(
-            "b1", part(nn.initializers.zeros_init(),
-                       (cfg.expert_axis, None)),
-            (e, cfg.ffn_size), cfg.param_dtype)
         w2 = self.param(
             "w2", part(nn.initializers.he_normal(),
                        (cfg.expert_axis, None, None)),
             (e, cfg.ffn_size, h), cfg.param_dtype)
-        b2 = self.param(
-            "b2", part(nn.initializers.zeros_init(),
-                       (cfg.expert_axis, None)),
-            (e, h), cfg.param_dtype)
+        if cfg.use_bias:
+            b1 = self.param(
+                "b1", part(nn.initializers.zeros_init(),
+                           (cfg.expert_axis, None)),
+                (e, cfg.ffn_size), cfg.param_dtype)
+            b2 = self.param(
+                "b2", part(nn.initializers.zeros_init(),
+                           (cfg.expert_axis, None)),
+                (e, h), cfg.param_dtype)
 
         # dispatch: (G,S,E,C) x (G,S,H) -> (G,E,C,H); GSPMD turns the
         # E-sharded contraction into the token all-to-all
         xin = jnp.einsum("gsec,gsh->gech", dispatch.astype(cfg.dtype),
                          x.astype(cfg.dtype))
         act = resolve_activation(cfg.activation, gelu_approximate=True)
-        hmid = act(jnp.einsum(
+        pre = jnp.einsum(
             "gech,ehf->gecf", xin, w1.astype(cfg.dtype),
             preferred_element_type=jnp.float32)
-            + b1[None, :, None].astype(jnp.float32))
+        if cfg.use_bias:
+            pre = pre + b1[None, :, None].astype(jnp.float32)
+        hmid = act(pre)
+        if cfg.gated:
+            # SwiGLU-style experts (Mixtral): elementwise gate from a
+            # third expert matrix, sharded identically over the
+            # expert axis (no bias, as the Llama-family recipe)
+            wg = self.param(
+                "wg", part(nn.initializers.he_normal(),
+                           (cfg.expert_axis, None, None)),
+                (e, h, cfg.ffn_size), cfg.param_dtype)
+            hmid = hmid * jnp.einsum(
+                "gech,ehf->gecf", xin, wg.astype(cfg.dtype),
+                preferred_element_type=jnp.float32)
         yout = jnp.einsum(
             "gecf,efh->gech", hmid.astype(cfg.dtype),
             w2.astype(cfg.dtype),
-            preferred_element_type=jnp.float32) \
-            + b2[None, :, None].astype(jnp.float32)
+            preferred_element_type=jnp.float32)
+        if cfg.use_bias:
+            yout = yout + b2[None, :, None].astype(jnp.float32)
         y = jnp.einsum("gsec,gech->gsh", combine, yout)
         return y.astype(x.dtype), cfg.aux_loss_weight * aux

@@ -117,3 +117,123 @@ class TestMoEMLP:
             assert np.all(np.isfinite(np.asarray(leaf)))
         # gate must receive gradient (through combine weights + aux)
         assert float(jnp.sum(jnp.abs(g["gate"]))) > 0.0
+
+
+class TestMoEInModelZoo:
+    """num_moe_experts wires MoEMLP into every transformer layer
+    (Mixtral-style) — model-level contract: routing works under the
+    scanned/unrolled stacks, the aux loss reaches the caller through
+    the sown "losses" collection, and the router is trained by it."""
+
+    def _tiny_moe(self, scan, **kw):
+        from apex_tpu.models import LlamaConfig, LlamaModel
+
+        cfg = LlamaConfig.tiny(num_moe_experts=4, moe_top_k=2,
+                               scan_layers=scan, **kw)
+        return cfg, LlamaModel(cfg)
+
+    @pytest.mark.parametrize("scan", [False, True])
+    def test_forward_and_aux_loss(self, rng, scan):
+        import jax.numpy as jnp
+
+        from apex_tpu.models import moe_aux_loss
+
+        cfg, model = self._tiny_moe(scan)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)),
+                          jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        logits, mut = model.apply(
+            {"params": params["params"]}, ids, mutable=["losses"])
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        aux = moe_aux_loss(mut)
+        # Switch load-balance loss is >= 1 at weight 1 for an
+        # imperfectly balanced router; weighted by 1e-2 x num_layers
+        assert float(aux) > 0.0
+        # without mutable=["losses"] the sow is dropped, not an error
+        logits2 = model.apply({"params": params["params"]}, ids)
+        np.testing.assert_allclose(np.asarray(logits2),
+                                   np.asarray(logits), rtol=1e-6,
+                                   atol=1e-6)
+
+    def test_router_gets_gradient_from_aux(self, rng):
+        import jax.numpy as jnp
+
+        from apex_tpu.models import gpt_loss_fn, moe_aux_loss
+
+        cfg, model = self._tiny_moe(False)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 17)),
+                          jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids[:, :-1])
+
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p}, ids[:, :-1], mutable=["losses"])
+            return (gpt_loss_fn(logits.astype(jnp.float32), ids[:, 1:])
+                    + moe_aux_loss(mut))
+
+        grads = jax.grad(loss_fn)(params["params"])
+        gate = grads["transformer"]["layer_0"]["moe_mlp"]["gate"]
+        assert float(jnp.max(jnp.abs(gate))) > 0.0
+        assert all(bool(jnp.isfinite(g).all())
+                   for g in jax.tree.leaves(grads))
+
+    def test_decode_matches_full_forward(self, rng):
+        """Greedy decode through the cache must match the full forward
+        (per-token routing is independent; ample capacity -> no
+        drops on either path)."""
+        import jax.numpy as jnp
+
+        cfg, model = self._tiny_moe(False, moe_capacity_factor=4.0)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 10)),
+                          jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        params = {"params": params["params"]}
+        full = model.apply(params, ids, deterministic=True)
+        from apex_tpu.models import init_cache
+
+        cache = init_cache(model, 2)
+        logits, vars_ = model.apply(
+            {**params, "cache": cache}, ids[:, :4],
+            deterministic=True, decode=True, mutable=["cache"])
+        outs = [logits]
+        for t in range(4, 10):
+            step, vars_ = model.apply(
+                {**params, "cache": vars_["cache"]}, ids[:, t:t + 1],
+                deterministic=True, decode=True, mutable=["cache"])
+            outs.append(step)
+        inc = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_mixtral_preset_geometry(self):
+        from apex_tpu.models import LlamaConfig
+
+        cfg = LlamaConfig.mixtral_8x7b()
+        assert cfg.num_moe_experts == 8 and cfg.moe_top_k == 2
+        assert cfg.sliding_window == 4096 and cfg.gated_mlp
+        assert cfg.num_kv_heads == 8 and cfg.norm == "rmsnorm"
+
+    def test_moe_config_validation(self):
+        from apex_tpu.models import LlamaConfig
+
+        with pytest.raises(ValueError, match="num_moe_experts"):
+            LlamaConfig.tiny(num_moe_experts=1)
+        with pytest.raises(ValueError, match="moe_top_k"):
+            LlamaConfig.tiny(num_moe_experts=2, moe_top_k=3)
+
+    def test_init_is_pure_params_and_biasfree_experts(self, rng):
+        """Round-5 review regressions: (a) init must NOT leak a sown
+        'losses' collection (it would ride into optimizer state and
+        double-count on the first apply); (b) bias-free recipes
+        (add_bias_linear=False, the Llama/Mixtral family) must get
+        bias-free experts."""
+        import jax.numpy as jnp
+
+        cfg, model = self._tiny_moe(False)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        assert set(variables) == {"params"}, set(variables)
+        moe = variables["params"]["transformer"]["layer_0"]["moe_mlp"]
+        assert cfg.add_bias_linear is False
+        assert "b1" not in moe and "b2" not in moe, sorted(moe)
+        assert "wg" in moe                      # gated (SwiGLU) experts
