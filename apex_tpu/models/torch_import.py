@@ -260,7 +260,7 @@ def _llama_layer_values(sd, i: int, num_heads: int,
          lin(p + "self_attn.v_proj.weight")], axis=-1)
     qkv = (_qkv_flat_to_grouped(qkv_flat, num_heads, num_kv_heads)
            if qkv_grouped else qkv_flat)
-    return {
+    out = {
         ("input_norm", "scale"):
             _to_np(sd[p + "input_layernorm.weight"]),
         ("attention", "qkv_proj", "kernel"): qkv,
@@ -268,13 +268,37 @@ def _llama_layer_values(sd, i: int, num_heads: int,
             lin(p + "self_attn.o_proj.weight"),
         ("post_attention_norm", "scale"):
             _to_np(sd[p + "post_attention_layernorm.weight"]),
-        ("mlp", "dense_h_to_4h_gate", "kernel"):
-            lin(p + "mlp.gate_proj.weight"),
-        ("mlp", "dense_h_to_4h", "kernel"):
-            lin(p + "mlp.up_proj.weight"),
-        ("mlp", "dense_4h_to_h", "kernel"):
-            lin(p + "mlp.down_proj.weight"),
     }
+    moe = p + "block_sparse_moe."
+    if moe + "gate.weight" in sd:
+        # Mixtral sparse-MoE layer → our MoEMLP: router gate (E, h) →
+        # (h, E); per-expert w1 (silu branch) → stacked w1, w3 (linear
+        # branch) → wg, w2 (down) → w2.  Routing semantics agree:
+        # HF softmaxes the top-k selected logits, we softmax-then-
+        # renormalize over the selected k — algebraically identical.
+        n_e = 0
+        while moe + f"experts.{n_e}.w1.weight" in sd:
+            n_e += 1
+        if n_e == 0:
+            raise KeyError(
+                f"checkpoint has '{moe}gate.weight' but no "
+                f"'{moe}experts.0.w1.weight' — unrecognized expert "
+                f"weight layout")
+        out[("moe_mlp", "gate")] = lin(moe + "gate.weight")
+        out[("moe_mlp", "w1")] = np.stack(
+            [lin(moe + f"experts.{j}.w1.weight") for j in range(n_e)])
+        out[("moe_mlp", "wg")] = np.stack(
+            [lin(moe + f"experts.{j}.w3.weight") for j in range(n_e)])
+        out[("moe_mlp", "w2")] = np.stack(
+            [lin(moe + f"experts.{j}.w2.weight") for j in range(n_e)])
+    else:
+        out[("mlp", "dense_h_to_4h_gate", "kernel")] = lin(
+            p + "mlp.gate_proj.weight")
+        out[("mlp", "dense_h_to_4h", "kernel")] = lin(
+            p + "mlp.up_proj.weight")
+        out[("mlp", "dense_4h_to_h", "kernel")] = lin(
+            p + "mlp.down_proj.weight")
+    return out
 
 
 def load_torch_llama(params: Any, state_dict: Mapping[str, Any], *,
@@ -289,9 +313,14 @@ def load_torch_llama(params: Any, state_dict: Mapping[str, Any], *,
     checkpoints work: pass the checkpoint's ``num_key_value_heads`` as
     ``num_kv_heads`` and the q/k/v projections are packed per kv group
     to match ``ParallelAttention``'s grouped reshape (``qkv_grouped``
-    must match the model config, as for GPT-2).  Both unrolled
-    (``layer_{i}``) and scanned parameter forms are handled, and
-    ``nn.Partitioned``-boxed leaves keep their sharding metadata.
+    must match the model config, as for GPT-2).  ``MixtralForCausalLM``
+    checkpoints are detected per layer by their ``block_sparse_moe``
+    keys and land on the MoE layer form (build the model with
+    ``num_moe_experts`` matching ``num_local_experts`` and
+    ``moe_top_k = num_experts_per_tok``; HF's softmax-over-selected
+    routing equals this library's softmax-then-renormalize).  Both
+    unrolled (``layer_{i}``) and scanned parameter forms are handled,
+    and ``nn.Partitioned``-boxed leaves keep their sharding metadata.
 
     RoPE conventions agree by construction: HF Llama's rotate-half and
     this library's :func:`~apex_tpu.ops.rope.fused_rope` both rotate
@@ -325,6 +354,18 @@ def load_torch_llama(params: Any, state_dict: Mapping[str, Any], *,
                 "checkpoint has an untied lm_head but the model ties "
                 "embeddings — build it with tie_embeddings=False")
 
+    ckpt_moe = any(".block_sparse_moe.gate.weight" in k for k in sd)
+    sub = tree["transformer"].get(
+        "layer_0", tree["transformer"].get("layers", {}).get("layer", {}))
+    model_moe = "moe_mlp" in sub
+    if ckpt_moe != model_moe:
+        raise ValueError(
+            "checkpoint/model MLP form mismatch: the checkpoint "
+            + ("has Mixtral block_sparse_moe layers — build the model "
+               "with num_moe_experts=num_local_experts and "
+               "moe_top_k=num_experts_per_tok" if ckpt_moe else
+               "has dense MLP layers but the model was built with "
+               "num_moe_experts"))
     n_ckpt = sum(1 for k in sd if k.endswith(".input_layernorm.weight"))
     _write_layers(
         tree["transformer"], n_ckpt,

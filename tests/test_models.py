@@ -490,6 +490,52 @@ class TestTorchImport:
             np.float32)
         np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
 
+    def test_mixtral_logits_match_torch(self):
+        """Mixtral = llama recipe + sparse MoE: the importer maps
+        block_sparse_moe (router + per-expert w1/w3/w2) onto MoEMLP,
+        and logits must agree — which also proves the two routing
+        formulations (HF softmax-over-selected-k vs this library's
+        softmax-then-renormalize) compute the same function."""
+        import torch
+        from transformers import MixtralConfig as HFMixtralConfig
+        from transformers import MixtralForCausalLM
+
+        from apex_tpu.models import LlamaConfig, LlamaModel
+        from apex_tpu.models.torch_import import load_torch_llama
+
+        torch.manual_seed(5)
+        tm = MixtralForCausalLM(HFMixtralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2, max_position_embeddings=32,
+            rope_theta=1e6, rms_norm_eps=1e-5,
+            attention_dropout=0.0, tie_word_embeddings=False,
+            attn_implementation="eager")).eval()
+
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=64, ffn_hidden_size=96,
+            num_layers=2, num_heads=4, num_kv_heads=2,
+            num_moe_experts=4, moe_top_k=2,
+            # HF Mixtral drops no tokens; capacity >= S*k guarantees
+            # the capacity-bounded dispatch drops none either
+            moe_capacity_factor=4.0,
+            rope_base=1e6, layernorm_eps=1e-5,
+            max_seq_len=32, scan_layers=False)
+        model = LlamaModel(cfg)
+        ids_np = np.random.default_rng(5).integers(
+            0, 128, size=(2, 16)).astype(np.int64)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.asarray(ids_np, jnp.int32))
+        params = load_torch_llama(params, tm.state_dict(),
+                                  num_heads=4, num_kv_heads=2)
+        with torch.no_grad():
+            want = tm(torch.from_numpy(ids_np)).logits.numpy()
+        got = np.asarray(model.apply(
+            params, jnp.asarray(ids_np, jnp.int32), deterministic=True),
+            np.float32)
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
     def test_llama_tied_checkpoint_imports(self):
         """torch state_dict() lists the tied head under both names —
         the importer must accept it into a tie_embeddings=True model."""
