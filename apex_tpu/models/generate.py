@@ -89,7 +89,16 @@ def _compiled_run(model, max_new_tokens: int, temperature: float,
             deterministic=True, decode=True, mutable=["cache"])
         return logits, upd["cache"]
 
-    @jax.jit
+    # the caller-supplied cache is freshly zero-initialized per
+    # generate() call and dead after it — donate it so XLA reuses its
+    # HBM for the updated cache instead of holding both copies live
+    # (num_layers · b · S · kv_heads · d · 2 leaves; at llama_1b
+    # b=32/S=8192 that is the difference between one and two ~2.7 GB
+    # cache footprints).  Donation works only through input→output
+    # aliasing, so run() must RETURN the final cache (generate()
+    # discards it) — donating without the matching output would be
+    # silently ignored with an unusable-donation warning.
+    @functools.partial(jax.jit, donate_argnums=(1,))
     def run(variables, cache, prompt_ids, rng):
         b, plen = prompt_ids.shape
         if prefill_chunk and plen > prefill_chunk:
@@ -133,12 +142,12 @@ def _compiled_run(model, max_new_tokens: int, temperature: float,
                 nxt = jnp.where(done, eos_id, nxt)
             return (cache, nxt, done, rng), tok
 
-        (_, last, _, _), toks = jax.lax.scan(
+        (cache, last, _, _), toks = jax.lax.scan(
             step, (cache, tok, done0, rng), None,
             length=max_new_tokens - 1)
         toks = jnp.moveaxis(toks, 0, 1)              # (b, n-1)
         return jnp.concatenate(
-            [prompt_ids, toks, last[:, None]], axis=1)
+            [prompt_ids, toks, last[:, None]], axis=1), cache
 
     return run
 
@@ -193,4 +202,6 @@ def generate(model, params, prompt_ids, *, max_new_tokens: int,
                         None if top_k is None else int(top_k),
                         None if eos_id is None else int(eos_id),
                         int(prefill_chunk))
-    return run(dict(params), cache, prompt_ids, rng)
+    # the final cache rides along purely as the donation alias target
+    ids, _final_cache = run(dict(params), cache, prompt_ids, rng)
+    return ids

@@ -105,9 +105,31 @@ class LlamaConfig(GPTConfig):
         replaced by 8 SwiGLU experts under top-2 token-choice routing
         (sliding window included).  Apply with ``mutable=["losses"]``
         and add :func:`~apex_tpu.models.moe_aux_loss` to the task
-        loss."""
+        loss.
+
+        ``moe_capacity_factor`` defaults to the *drop-free* value
+        ``num_experts / top_k`` (= 4.0): per-expert capacity is
+        ``cf·S·k/E`` tokens, so cf = E/k makes capacity = S and no
+        routing assignment can ever be dropped.  HF Mixtral has no
+        capacity bound at all — with the training default (1.25) an
+        imbalanced real checkpoint drops assignments and the combine
+        renormalization silently diverges from HF (ADVICE round 5).
+
+        The parity default costs memory: the dispatch/combine masks
+        are ``(S, E, C)`` fp32 per batch row with ``C = cf·S·k/E``,
+        so cf = 4.0 makes them quadratic in sequence length — 3.2x
+        the old 1.25 default, transiently per MoE layer.  Training
+        from scratch (where HF parity is irrelevant and token drop is
+        routine) should pass a tighter ``moe_capacity_factor``
+        explicitly; imported-checkpoint inference should keep the
+        drop-free default."""
         kw.setdefault("num_moe_experts", 8)
         kw.setdefault("moe_top_k", 2)
+        # num_moe_experts=None is the dense twin of the preset; bad
+        # values (top_k=0) go straight to config validation
+        if kw["num_moe_experts"] and kw["moe_top_k"]:
+            kw.setdefault("moe_capacity_factor",
+                          kw["num_moe_experts"] / kw["moe_top_k"])
         return cls.mistral_7b(**kw)
 
     @classmethod

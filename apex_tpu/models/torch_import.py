@@ -318,7 +318,14 @@ def load_torch_llama(params: Any, state_dict: Mapping[str, Any], *,
     keys and land on the MoE layer form (build the model with
     ``num_moe_experts`` matching ``num_local_experts`` and
     ``moe_top_k = num_experts_per_tok``; HF's softmax-over-selected
-    routing equals this library's softmax-then-renormalize).  Both
+    routing equals this library's softmax-then-renormalize).  MoE
+    parity caveat: HF Mixtral never drops tokens, while this library's
+    dispatch is capacity-bounded — logits agree with HF only under a
+    drop-free capacity, ``moe_capacity_factor >= num_experts / top_k``
+    (the :meth:`~apex_tpu.models.llama.LlamaConfig.mixtral_8x7b`
+    preset's default).  A smaller factor drops assignments on
+    imbalanced routing and the combine renormalization then silently
+    diverges from HF.  Both
     unrolled (``layer_{i}``) and scanned parameter forms are handled,
     and ``nn.Partitioned``-boxed leaves keep their sharding metadata.
 

@@ -20,7 +20,6 @@ free because everything is functional).
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any, Optional, Tuple
 
 import jax
@@ -103,6 +102,15 @@ class TransformerConfig:
     # with remat=True and unrolled layers: every k-th layer skips remat
     # entirely (keeps activations, no backward recompute) — 0 disables
     remat_skip_every: int = 0
+    # dense-cache steady-decode attention implementation: "einsum"
+    # (one-shot masked einsum over the whole cache), "blocked"
+    # (online-softmax scan that skips blocks past the live prefix), or
+    # "auto" (blocked from 2048 cache slots up — the measured winner,
+    # BASELINE.md round 5).  A config field, NOT an env var: the choice
+    # is part of the module hash and therefore of every jit/lru cache
+    # key, so A/B flips retrace instead of silently replaying the old
+    # executable (ADVICE round 5; graftlint env-read-in-trace).
+    decode_attn: str = "auto"
     # flash-attention kernel tile sizes; None = the kernel's seq-aware
     # default (512 at short seq — isolated-op sweeps can mislead: in
     # the full rematted model 512/512 measures fastest at s=512 — and
@@ -155,11 +163,18 @@ class TransformerConfig:
                 raise ValueError(
                     f"sliding_window must be >= 1, got "
                     f"{self.sliding_window}")
+        if self.decode_attn not in ("auto", "einsum", "blocked"):
+            raise ValueError(
+                f"decode_attn={self.decode_attn!r} not in "
+                "('auto', 'einsum', 'blocked')")
         if self.num_moe_experts is not None:
             if self.num_moe_experts < 2:
                 raise ValueError(
                     f"num_moe_experts must be >= 2, got "
                     f"{self.num_moe_experts}")
+            if self.moe_top_k < 1:
+                raise ValueError(
+                    f"moe_top_k must be >= 1, got {self.moe_top_k}")
             if self.moe_top_k > self.num_moe_experts:
                 raise ValueError(
                     f"moe_top_k ({self.moe_top_k}) cannot exceed "
@@ -417,9 +432,13 @@ class ParallelAttention(nn.Module):
                     # prefix — measured on-chip (decode bench,
                     # BASELINE.md round-5): +30% tokens/s at S=2048
                     # and 2.3x at S=8192 (b=8, llama_1b), so it is the
-                    # default from 2048 slots up.  APEX_TPU_DECODE_ATTN
-                    # ∈ {einsum, blocked} overrides for A/B.
-                    mode = os.environ.get("APEX_TPU_DECODE_ATTN", "auto")
+                    # default from 2048 slots up.  cfg.decode_attn
+                    # ∈ {einsum, blocked} overrides for A/B (a config
+                    # field so the choice is part of the compile
+                    # signature — the old APEX_TPU_DECODE_ATTN env read
+                    # here was captured at trace time and a mid-process
+                    # flip was a silent no-op).
+                    mode = cfg.decode_attn
                     if mode == "blocked" or (
                             mode == "auto" and S >= 2048):
                         o = _cache_attention_blocked(
@@ -448,16 +467,23 @@ class ParallelAttention(nn.Module):
                                      window=Wc,
                                      key_positions=pos - 1)
             else:
-                # multi-token chunk at ANY position: the banded flash
-                # kernel covers in-chunk attention, and only queries in
-                # the chunk's first min(Wc, s) offsets can also see
+                # multi-token chunk at ANY position.  Only queries in
+                # the chunk's first hlen = min(Wc, s) offsets can see
                 # ring entries (offset i >= Wc has pos_q - Wc >= idx,
-                # putting every ring key out of window) — those rows
-                # are recomputed by a masked einsum over
-                # [ring ‖ chunk-head] with per-slot positions.  On the
-                # first call the ring is empty (slot_positions == 0 →
-                # k_pos == -1, masked), so prefill needs no special
-                # case.
+                # putting every ring key out of window), so those head
+                # rows run the blocked online-softmax einsum over
+                # [ring ‖ chunk-head] with per-slot positions.  When
+                # s <= Wc (e.g. 2048-token auto prefill chunks against
+                # Mistral's 4096 window) hlen == s and the WHOLE chunk
+                # is that blocked einsum — the banded flash kernel is
+                # not invoked at all.  Only when s > Wc do the
+                # remaining rows (pure in-chunk attention) go through
+                # the banded kernel; it computes all s rows and the
+                # first hlen are discarded by the [:, hlen:] slice —
+                # redundant work bounded by hlen/s <= Wc/s < 1 of the
+                # kernel call.  On the first call the ring is empty
+                # (slot_positions == 0 → k_pos == -1, masked), so
+                # prefill needs no special case.
                 hlen = min(Wc, s)
                 cat_k = jnp.concatenate([ck.value, k[:, :hlen]], axis=1)
                 cat_v = jnp.concatenate([cv.value, v[:, :hlen]], axis=1)

@@ -114,7 +114,7 @@ def ring_attention(q, k, v, axis: str = CONTEXT_AXIS,
     return o
 
 
-def _fwd_accum(q, k, v, axis, causal, scale):
+def _fwd_accum(q, k, v, axis: str, causal: bool, scale: float):
     """The forward ring: returns (o fp32 grouped (b,sq,hk,g,d), lse)."""
     cp = lax.axis_size(axis)
     rank = lax.axis_index(axis)

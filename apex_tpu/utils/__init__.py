@@ -18,6 +18,10 @@ from apex_tpu.utils.debug import (
     enable_nan_checks, nan_check_mode, checkify_finite, tree_health,
 )
 from apex_tpu.utils.metrics import MetricsWriter, log_metrics
+from apex_tpu.utils.tracecheck import (
+    RetraceError, retrace_guard, trace_event_count,
+    reset_trace_event_count,
+)
 
 __all__ = [
     "is_floating",
@@ -34,4 +38,6 @@ __all__ = [
     "enable_nan_checks", "nan_check_mode", "checkify_finite",
     "tree_health",
     "MetricsWriter", "log_metrics",
+    "RetraceError", "retrace_guard", "trace_event_count",
+    "reset_trace_event_count",
 ]

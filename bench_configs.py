@@ -1497,7 +1497,11 @@ def _decode_single():
     S = int(os.environ["BENCH_DECODE_MAXLEN"])
     P = int(os.environ.get("BENCH_DECODE_PROMPT", "1024"))
     N = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
-    cfg = dataclasses.replace(_llama_1b_cfg("gqa"), max_seq_len=S)
+    # host-side read, plumbed through config (part of the compile
+    # signature) — the model no longer reads this env var at trace time
+    attn = os.environ.get("APEX_TPU_DECODE_ATTN", "auto")
+    cfg = dataclasses.replace(_llama_1b_cfg("gqa"), max_seq_len=S,
+                              decode_attn=attn)
     model = LlamaModel(cfg)
 
     ids = jax.random.randint(
@@ -1570,7 +1574,7 @@ def _decode_single():
     bytes_live = 2 * n_params + kvb * (P + N // 2)
     out = {
         "batch": b, "max_seq_len": S, "prompt": P,
-        "decode_attn": os.environ.get("APEX_TPU_DECODE_ATTN", "auto"),
+        "decode_attn": cfg.decode_attn,
         "num_params": int(n_params),
         "prefill_tokens_per_sec": round(b * P / t_pre, 1),
         "prefill_ms": round(t_pre * 1e3, 2),

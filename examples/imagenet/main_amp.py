@@ -24,6 +24,7 @@ model cast; O2/O3 cast the model via the precision policy.
 from __future__ import annotations
 
 import argparse
+import functools
 import time
 
 import jax
@@ -128,7 +129,10 @@ def main():
     images = jax.device_put(images, batch_sharding)
     labels = jax.device_put(labels, batch_sharding)
 
-    @jax.jit
+    # state and batch_stats are replaced every step — donate both so the
+    # old copies' HBM is reused (x/y are the same arrays each step and
+    # must stay undonated)
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(state, batch_stats, x, y):
         def loss_fn(p):
             logits, mut = state.apply_fn(p, x, batch_stats)

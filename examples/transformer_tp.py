@@ -21,6 +21,7 @@ Runs anywhere:
 from __future__ import annotations
 
 import argparse
+import functools
 import time
 
 import jax
@@ -96,7 +97,10 @@ def run_pipelined(args):
         inputs = jax.device_put(ids[:, :-1], NamedSharding(mesh, P("data")))
         labels = jax.device_put(ids[:, 1:], NamedSharding(mesh, P("data")))
 
-        @jax.jit
+        # the old state is dead once the new one returns — donate it so
+        # params/opt-state don't hold two copies of HBM across the step
+        # (inputs/labels are reused every step and must NOT be donated)
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def train_step(state, inputs, labels):
             def loss_fn(p_):
                 logits = pipe_forward(state.policy.cast_to_compute(p_),
@@ -155,7 +159,8 @@ def main():
             ["inputs", "labels"],
             {"inputs": ids[:, :-1], "labels": ids[:, 1:]}, jnp.int32)
 
-        @jax.jit
+        # donate the threaded state (batch tensors are reused per step)
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def train_step(state, inputs, labels):
             def loss_fn(p_):
                 logits = state.apply_fn(p_, inputs)

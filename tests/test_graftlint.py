@@ -1,0 +1,701 @@
+"""graftlint rule fixtures — one flagged and one clean source per rule,
+plus suppression/trace-inference/CLI coverage and the gate that the
+repo's own tree stays clean (the CI job's in-process twin).
+
+Pure AST work, no jax needed — but the shared conftest imports jax, so
+these run inside the normal hermetic suite.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools.graftlint.core import all_rules, lint_paths, lint_source, main
+
+
+def lint(src, rule=None):
+    """Findings for dedented ``src``, optionally one rule only."""
+    return lint_source(textwrap.dedent(src), "<fixture>",
+                       select=[rule] if rule else None)
+
+
+def names(findings):
+    return [f.rule for f in findings]
+
+
+def test_registry_has_at_least_eight_rules():
+    rules = all_rules()
+    assert len(rules) >= 8
+    for name, rule in rules.items():
+        assert rule.name == name and rule.summary
+
+
+# ----------------------------------------------------- rule fixtures
+
+class TestEnvReadInTrace:
+    RULE = "env-read-in-trace"
+
+    def test_flagged_inside_jitted_function(self):
+        found = lint("""
+            import os, jax
+
+            @jax.jit
+            def step(x):
+                mode = os.environ.get("APEX_TPU_DECODE_ATTN", "auto")
+                return x if mode == "einsum" else -x
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_flagged_inside_module_call(self):
+        found = lint("""
+            import os
+            import flax.linen as nn
+
+            class Attn(nn.Module):
+                def __call__(self, x):
+                    if os.getenv("FLAG"):
+                        return x
+                    return -x
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_module_level_read_near_trace_paths_is_advisory(self):
+        found = lint("""
+            import os, jax
+
+            DEBUG = os.environ.get("DEBUG", "0")
+
+            @jax.jit
+            def f(x):
+                return x
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "captured at import time" in found[0].message
+
+    def test_clean_untraced_helper(self):
+        assert lint("""
+            import os
+
+            def configure():
+                return os.environ.get("HOME", "/")
+        """, self.RULE) == []
+
+
+class TestTracedBranch:
+    RULE = "traced-branch"
+
+    def test_flagged_if_on_traced_value(self):
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                y = jnp.sum(x)
+                if y > 0:
+                    return y
+                return -y
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_flagged_while_on_traced_value(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                while x.sum() > 1:
+                    x = x / 2
+                return x
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_flagged_branch_inside_nested_loss_fn_closure(self):
+        # the canonical jit'd train_step with an inner loss_fn closing
+        # over the batch — the nested def is part of the same trace
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def train_step(state, batch):
+                def loss_fn(p):
+                    if batch.sum() > 0:
+                        return jnp.mean(p * batch)
+                    return jnp.mean(p)
+                return jax.grad(loss_fn)(state)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_nested_def_params_are_tainted(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                def inner(y):
+                    if y > 0:
+                        return y
+                    return -y
+                return inner(x)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_clean_config_typed_param_branch(self):
+        # *Config-typed params are hashable static config: branching
+        # on their fields specializes the trace on purpose
+        assert lint("""
+            import flax.linen as nn
+
+            def norm(cfg: TransformerConfig, name: str):
+                class Norm(nn.Module):
+                    def __call__(self, x):
+                        if cfg.norm == "rmsnorm":
+                            return x * cfg.eps
+                        return x
+                return Norm(name=name)
+
+            class Block(nn.Module):
+                def __call__(self, x):
+                    return norm(self.cfg, "pre")(x)
+        """, self.RULE) == []
+
+    def test_clean_annotated_static_flag_closure(self):
+        # an unannotated closure flag would over-taint; `causal: bool`
+        # marks it static for the whole nested trace
+        assert lint("""
+            import jax
+            from jax import lax
+
+            def accum(q, axis: str, causal: bool, scale: float):
+                def tick(carry, t):
+                    if causal:
+                        carry = carry * scale
+                    return carry, None
+                return lax.scan(tick, q, None, length=4)
+        """, self.RULE) == []
+
+    def test_clean_shape_branch_and_none_check(self):
+        assert lint("""
+            import jax
+
+            @jax.jit
+            def f(x, mask=None):
+                if x.shape[0] > 128:
+                    x = x[:128]
+                if mask is not None:
+                    x = x * mask
+                return x
+        """, self.RULE) == []
+
+
+class TestJitUnhashableDefault:
+    RULE = "jit-unhashable-default"
+
+    def test_flagged_dict_default(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def f(x, opts={}):
+                return x
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_flagged_call_site_list_default(self):
+        found = lint("""
+            import jax
+
+            def f(x, axes=[0, 1]):
+                return x
+
+            g = jax.jit(f)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_clean_hashable_defaults(self):
+        assert lint("""
+            import jax
+
+            @jax.jit
+            def f(x, axes=(0, 1), scale=1.0, mask=None):
+                return x
+        """, self.RULE) == []
+
+
+class TestJitMissingDonate:
+    RULE = "jit-missing-donate"
+
+    def test_flagged_train_step_without_donate(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def train_step(state, batch):
+                return state
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_clean_with_donate_argnums(self):
+        assert lint("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def train_step(state, batch):
+                return state
+        """, self.RULE) == []
+
+    def test_clean_no_state_shaped_params(self):
+        assert lint("""
+            import jax
+
+            @jax.jit
+            def forward(params, x):
+                return x
+        """, self.RULE) == []
+
+
+class TestLruCacheHazard:
+    RULE = "lru-cache-hazard"
+
+    def test_flagged_env_read_under_lru_cache(self):
+        found = lint("""
+            import functools, os
+
+            @functools.lru_cache(maxsize=8)
+            def compiled_run(n):
+                return os.environ.get("APEX_TPU_DECODE_ATTN", "auto"), n
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_flagged_unhashable_default(self):
+        found = lint("""
+            import functools
+
+            @functools.lru_cache
+            def build(shape=[1, 2]):
+                return tuple(shape)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_clean_hashable_pure(self):
+        assert lint("""
+            import functools
+
+            @functools.lru_cache(maxsize=None)
+            def build(shape=(1, 2), dtype="f32"):
+                return shape, dtype
+        """, self.RULE) == []
+
+
+class TestTimeInTrace:
+    RULE = "time-in-trace"
+
+    def test_flagged_wallclock_and_np_random(self):
+        found = lint("""
+            import time, jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                t0 = time.time()
+                noise = np.random.randn(4)
+                return x + noise, t0
+        """, self.RULE)
+        assert names(found) == [self.RULE, self.RULE]
+
+    def test_clean_timing_outside_jit(self):
+        assert lint("""
+            import time, jax
+
+            @jax.jit
+            def f(x):
+                return x * 2
+
+            def bench(x):
+                t0 = time.time()
+                f(x)
+                return time.time() - t0
+        """, self.RULE) == []
+
+
+class TestHostSyncInTrace:
+    RULE = "host-sync-in-trace"
+
+    def test_flagged_item_and_float(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                s = x.sum()
+                return float(s), s.item()
+        """, self.RULE)
+        assert names(found) == [self.RULE, self.RULE]
+
+    def test_flagged_float_inside_nested_loss_fn(self):
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def train_step(params, batch):
+                def loss_fn(p):
+                    return float(jnp.mean(p * batch))
+                return jax.grad(loss_fn)(params)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_clean_static_conversions(self):
+        assert lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                n = int(x.shape[0])
+                return x[:n]
+        """, self.RULE) == []
+
+
+class TestPrintInTrace:
+    RULE = "print-in-trace"
+
+    def test_flagged_print_of_tracer(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                print(x)
+                return x
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_flagged_fstring_of_tracer(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                msg = f"value = {x.sum()}"
+                return x
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_flagged_fstring_in_nested_closure_and_no_duplicates(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def train_step(params, batch):
+                def loss_fn(p):
+                    msg = f"loss input {batch.sum()}"
+                    return (p * batch).sum()
+                return jax.grad(loss_fn)(params)
+        """, self.RULE)
+        assert names(found) == [self.RULE]   # exactly once
+
+    def test_clean_fstring_in_raise_and_outside_print(self):
+        assert lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x.ndim != 2:
+                    raise ValueError(f"need 2D, got {x.ndim}, {x}")
+                return x
+
+            def report(y):
+                print(f"loss = {y}")
+        """, self.RULE) == []
+
+
+class TestMutableGlobalInTrace:
+    RULE = "mutable-global-in-trace"
+
+    def test_flagged_module_list_append(self):
+        found = lint("""
+            import jax
+
+            HISTORY = []
+
+            @jax.jit
+            def f(x):
+                HISTORY.append(1)
+                return x
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_flagged_global_rebind(self):
+        found = lint("""
+            import jax
+
+            STEPS = []
+
+            @jax.jit
+            def f(x):
+                global STEPS
+                STEPS = [x]
+                return x
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_clean_local_container(self):
+        assert lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                parts = []
+                parts.append(x)
+                return parts[0]
+        """, self.RULE) == []
+
+
+# ----------------------------------------------------- suppressions
+
+FLAGGED = """
+    import os, jax
+
+    @jax.jit
+    def f(x):
+        mode = os.getenv("MODE"){trailer}
+        return x
+"""
+
+
+class TestSuppression:
+    def test_trailing_disable(self):
+        src = FLAGGED.format(
+            trailer="  # graftlint: disable=env-read-in-trace")
+        assert lint(src, "env-read-in-trace") == []
+
+    def test_standalone_disable_covers_next_line(self):
+        found = lint("""
+            import os, jax
+
+            @jax.jit
+            def f(x):
+                # graftlint: disable=env-read-in-trace
+                mode = os.getenv("MODE")
+                return x
+        """, "env-read-in-trace")
+        assert found == []
+
+    def test_file_wide_disable(self):
+        found = lint("""
+            # graftlint: disable-file=env-read-in-trace
+            import os, jax
+
+            @jax.jit
+            def f(x):
+                mode = os.getenv("MODE")
+                return x
+        """, "env-read-in-trace")
+        assert found == []
+
+    def test_disable_all(self):
+        src = FLAGGED.format(trailer="  # graftlint: disable=all")
+        assert lint(src, "env-read-in-trace") == []
+
+    def test_trailing_commentary_does_not_break_suppression(self):
+        # the documented style: a suppression plus the why
+        src = FLAGGED.format(
+            trailer="  # graftlint: disable=env-read-in-trace — "
+                    "host-only value, never traced")
+        assert lint(src, "env-read-in-trace") == []
+
+    def test_wrong_rule_does_not_suppress(self):
+        src = FLAGGED.format(
+            trailer="  # graftlint: disable=traced-branch")
+        assert names(lint(src, "env-read-in-trace")) \
+            == ["env-read-in-trace"]
+
+    def test_not_traced_mark_opts_out(self):
+        found = lint("""
+            import os
+            import flax.linen as nn
+
+            class M(nn.Module):
+                def __call__(self, x):  # graftlint: not-traced
+                    return os.getenv("HOME"), x
+        """, "env-read-in-trace")
+        assert found == []
+
+    def test_traced_mark_opts_in(self):
+        found = lint("""
+            import os
+
+            def helper(x):  # graftlint: traced
+                return os.getenv("HOME"), x
+        """, "env-read-in-trace")
+        assert names(found) == ["env-read-in-trace"]
+
+
+# ------------------------------------------- trace-path inference
+
+class TestTraceInference:
+    def test_scan_callee_is_traced(self):
+        found = lint("""
+            import os
+            from jax import lax
+
+            def body(carry, x):
+                flag = os.getenv("FLAG")
+                return carry, x
+
+            def run(xs):
+                return lax.scan(body, 0, xs)
+        """, "env-read-in-trace")
+        assert names(found) == ["env-read-in-trace"]
+
+    def test_transitive_same_file_helper(self):
+        found = lint("""
+            import os, jax
+
+            def helper(x):
+                return os.getenv("MODE"), x
+
+            @jax.jit
+            def f(x):
+                return helper(x)
+        """, "env-read-in-trace")
+        assert names(found) == ["env-read-in-trace"]
+
+    def test_fori_loop_body_is_traced(self):
+        found = lint("""
+            import os
+            from jax import lax
+
+            def body(i, x):
+                return x * (2 if os.getenv("FLAG") else 3)
+
+            def run(x):
+                return lax.fori_loop(0, 10, body, x)
+        """, "env-read-in-trace")
+        assert names(found) == ["env-read-in-trace"]
+
+    def test_cond_false_branch_is_traced(self):
+        found = lint("""
+            import os
+            from jax import lax
+
+            def on_false(x):
+                return x * len(os.environ["SCALE"])
+
+            def run(pred, x):
+                return lax.cond(pred, lambda x: x, on_false, x)
+        """, "env-read-in-trace")
+        assert names(found) == ["env-read-in-trace"]
+
+    def test_switch_branches_are_traced(self):
+        found = lint("""
+            import os
+            from jax import lax
+
+            def branch_b(x):
+                return x + len(os.environ["B"])
+
+            def run(i, x):
+                return lax.switch(i, [lambda x: x, branch_b], x)
+        """, "env-read-in-trace")
+        # branch passed inside a list literal is not resolvable by
+        # name-position — but passed positionally it must be
+        found2 = lint("""
+            import os
+            from jax import lax
+
+            def branch_b(x):
+                return x + len(os.environ["B"])
+
+            def run(i, x):
+                return lax.switch(i, branch_b, x)
+        """, "env-read-in-trace")
+        assert names(found2) == ["env-read-in-trace"]
+
+    def test_cond_predicate_name_is_not_marked_traced(self):
+        # `flag` at cond's args[0] is the predicate, not a callable:
+        # a same-named def must NOT become a trace path
+        found = lint("""
+            import os
+            from jax import lax
+
+            def flag():
+                return os.getenv("FLAG") == "1"
+
+            def run(flag, x):
+                return lax.cond(flag, lambda x: x, lambda x: -x, x)
+        """, "env-read-in-trace")
+        assert found == []
+
+    def test_kwargs_catchall_is_tainted(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def f(x, **kw):
+                if kw["mask"].sum() > 0:
+                    return x
+                return -x
+        """, "traced-branch")
+        assert names(found) == ["traced-branch"]
+
+    def test_parse_error_is_reported_not_raised(self):
+        found = lint_source("def f(:\n", "<bad>")
+        assert names(found) == ["parse-error"]
+
+    def test_no_duplicate_findings_for_repeated_jit_sites(self):
+        found = lint("""
+            import jax
+
+            def train_step(state, batch):
+                return state
+
+            a = jax.jit(train_step)
+            b = jax.jit(train_step)
+        """, "jit-missing-donate")
+        assert names(found) == ["jit-missing-donate"]
+
+
+# -------------------------------------------------------- CLI / tree
+
+class TestCli:
+    def test_exit_codes_and_json(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import os, jax
+
+            @jax.jit
+            def f(x):
+                return os.getenv("MODE"), x
+        """))
+        assert main([str(bad), "--format", "json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload and payload[0]["rule"] == "env-read-in-trace"
+
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        assert main([str(good)]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_unknown_rule_and_missing_path_are_errors(self, capsys):
+        assert main(["--select", "no-such-rule", "."]) == 2
+        assert main(["/no/such/path.py"]) == 2
+
+    def test_list_rules(self, capsys):
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "env-read-in-trace" in out
+        assert "jit-missing-donate" in out
+
+
+def test_repo_tree_is_clean():
+    """The CI gate, in-process: apex_tpu/tools/examples lint clean."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    targets = [os.path.join(root, d)
+               for d in ("apex_tpu", "tools", "examples")]
+    findings = lint_paths(targets)
+    assert findings == [], "\n".join(f.render() for f in findings)

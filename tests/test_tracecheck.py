@@ -1,0 +1,156 @@
+"""tracecheck runtime guard — the dynamic oracle behind graftlint.
+
+Covers the ISSUE satellite: a shape-polymorphic call pattern under
+``retrace_guard`` trips at ``max_traces``, while a stable-signature
+train step compiles once and never trips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.utils import tracecheck
+from apex_tpu.utils.tracecheck import RetraceError, retrace_guard
+
+
+class TestRetraceGuard:
+    def test_shape_polymorphic_calls_trip_at_max_traces(self):
+        step = retrace_guard(lambda x: x * 2, max_traces=2, name="poly")
+        step(jnp.ones((4,)))
+        step(jnp.ones((8,)))          # second shape: still within budget
+        assert step.trace_count == 2
+        with pytest.raises(RetraceError) as exc:
+            step(jnp.ones((16,)))     # third distinct shape: storm
+        msg = str(exc.value)
+        assert "poly" in msg and "max_traces=2" in msg
+        # the error names the rejected signature and the compiled ones
+        assert "[16]" in msg and "[4]" in msg
+
+    def test_post_budget_calls_do_not_grow_state(self):
+        # a harness catching RetraceError and retrying must not inflate
+        # the count (failed traces are never cached by jit)
+        f = retrace_guard(lambda x: x, max_traces=1)
+        f(jnp.ones((2,)))
+        for _ in range(3):
+            with pytest.raises(RetraceError):
+                f(jnp.ones((5,)))
+        assert f.trace_count == 1
+        assert len(f.signatures) == 1
+
+    def test_body_exception_propagates_without_consuming_budget(self):
+        # a failed trace is never jit-cached, so it must not count:
+        # retrying a call whose body raises a real error has to keep
+        # raising THAT error, not a spurious RetraceError
+        def bad(x):
+            raise ValueError("boom")
+
+        f = retrace_guard(bad, max_traces=1)
+        for _ in range(3):
+            with pytest.raises(ValueError, match="boom"):
+                f(jnp.ones((2,)))
+        assert f.trace_count == 0
+        assert f.signatures == []
+
+    def test_stable_train_step_compiles_once(self):
+        tx = optax.sgd(1e-2)
+        params = {"w": jnp.ones((8, 8)), "b": jnp.zeros((8,))}
+        opt_state = tx.init(params)
+
+        @retrace_guard(max_traces=1)
+        def train_step(params, opt_state, x, y):
+            def loss_fn(p):
+                return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        x = jnp.ones((16, 8))
+        y = jnp.zeros((16, 8))
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = train_step(params, opt_state, x, y)
+            losses.append(float(loss))
+        assert train_step.trace_count == 1
+        assert losses[-1] < losses[0]  # and it actually trains
+
+    def test_dtype_change_counts_as_new_trace(self):
+        f = retrace_guard(lambda x: x + 1, max_traces=1)
+        f(jnp.ones((4,), jnp.float32))
+        with pytest.raises(RetraceError):
+            f(jnp.ones((4,), jnp.bfloat16))
+
+    def test_cache_hits_do_not_count(self):
+        f = retrace_guard(lambda x: x + 1, max_traces=1)
+        for _ in range(10):
+            f(jnp.ones((4,)))
+        assert f.trace_count == 1
+
+    def test_decorator_without_arguments(self):
+        @retrace_guard
+        def f(x):
+            return x * 3
+
+        np.testing.assert_allclose(f(jnp.ones((2,))), 3.0)
+        assert f.trace_count == 1 and f.max_traces == 2
+
+    def test_reset_restores_budget(self):
+        f = retrace_guard(lambda x: x, max_traces=1)
+        f(jnp.ones((2,)))
+        with pytest.raises(RetraceError):
+            f(jnp.ones((3,)))
+        f.reset()
+        assert f.trace_count == 0 and f.signatures == []
+        f(jnp.ones((3,)))             # fresh budget, no raise
+        assert f.trace_count == 1
+
+    def test_jit_kwargs_pass_through(self):
+        f = retrace_guard(lambda n: jnp.zeros((n,)), max_traces=1,
+                          static_argnums=(0,))
+        assert f(4).shape == (4,)
+
+    def test_rejects_already_jitted_function(self):
+        jitted = jax.jit(lambda x: x)
+        with pytest.raises(TypeError, match="un-jitted"):
+            retrace_guard(jitted)
+
+    def test_max_traces_must_be_positive(self):
+        with pytest.raises(ValueError):
+            retrace_guard(lambda x: x, max_traces=0)
+
+    def test_wrap_jit_false_counts_every_python_execution(self):
+        f = retrace_guard(lambda x: x + 1, max_traces=2, wrap_jit=False)
+        f(jnp.ones((2,)))
+        f(jnp.ones((2,)))             # no jit cache: body runs again
+        with pytest.raises(RetraceError):
+            f(jnp.ones((2,)))
+
+
+class TestTraceEventCounter:
+    def test_counter_sees_traces_and_ignores_cache_hits(self):
+        available = tracecheck.install_trace_counter()
+        if not available:
+            pytest.skip("jax.monitoring listener API unavailable")
+
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        tracecheck.reset_trace_event_count()
+        f(jnp.ones((7,)))                       # miss: traces
+        after_first = tracecheck.trace_event_count()
+        assert after_first >= 1
+        f(jnp.ones((7,)))                       # hit: no new traces
+        assert tracecheck.trace_event_count() == after_first
+        f(jnp.ones((9,)))                       # new shape: traces again
+        assert tracecheck.trace_event_count() > after_first
+
+    def test_reset_zeroes(self):
+        tracecheck.reset_trace_event_count()
+        assert tracecheck.trace_event_count() == 0
+
+    def test_exported_from_utils_package(self):
+        from apex_tpu import utils
+        assert utils.retrace_guard is retrace_guard
+        assert utils.RetraceError is RetraceError

@@ -1,0 +1,24 @@
+"""graftlint — JAX trace-hygiene static analyzer for this repo.
+
+Catches the footgun class that silently erases fused-kernel wins:
+trace-time environment capture, python branching on traced values,
+cache-defeating jit signatures, wall-clock/RNG/print side effects
+baked into traces, and mutable global state touched from traced code.
+
+CLI::
+
+    python -m tools.graftlint apex_tpu tools examples
+    python -m tools.graftlint --list-rules
+    python -m tools.graftlint --format json apex_tpu
+
+Exit status: 0 clean, 1 findings, 2 usage error.  Docs:
+``docs/graftlint.md``.  The runtime counterpart (a retrace counter
+tests can assert on) is :mod:`apex_tpu.utils.tracecheck`.
+"""
+
+from tools.graftlint.core import (
+    Finding, Rule, all_rules, lint_paths, lint_path, lint_source, main,
+)
+
+__all__ = ["Finding", "Rule", "all_rules", "lint_paths", "lint_path",
+           "lint_source", "main"]

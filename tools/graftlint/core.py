@@ -1,0 +1,712 @@
+"""graftlint core: AST framework, trace-path inference, taint engine.
+
+The analyzer is a single parse per file feeding a set of registered
+rules (``tools/graftlint/rules.py``).  Everything JAX-specific that
+rules share lives here:
+
+- :class:`ModuleContext` — parsed tree + parent links + suppression
+  map for one file;
+- **trace-path inference** (:func:`ModuleContext.traced_functions`) —
+  which function bodies execute *at trace time*: jit-family decorators
+  (``jax.jit``/``pjit``/``vmap``/``grad``/``checkpoint``/...),
+  ``__call__``/``@nn.compact`` methods of ``nn.Module`` subclasses,
+  functions passed by name to jit-family call sites or
+  ``lax.scan``/``cond``/``while_loop``, plus the transitive closure
+  over same-file bare-name calls and lexical nesting.  ``# graftlint:
+  traced`` on a ``def`` line force-marks it; ``# graftlint:
+  not-traced`` opts out.
+- a **taint engine** (:func:`taint_function`, :func:`expr_tainted`) —
+  a one-pass, forward, no-kill dataflow marking names derived from a
+  traced function's array arguments.  Static metadata accessors
+  (``.shape``/``.ndim``/``.dtype``/``len()``/...) sanitize, so
+  ``b, s, _ = x.shape`` stays untainted while ``y = x.sum()`` taints.
+
+Suppression syntax (checked per finding line):
+
+- trailing ``# graftlint: disable=<rule>[,<rule>...]`` suppresses on
+  that line;
+- a standalone ``# graftlint: disable=...`` comment line suppresses
+  the line directly below it;
+- ``# graftlint: disable-file=<rule>[,...]`` anywhere suppresses the
+  rule for the whole file (``all`` works in both forms).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import os
+import sys
+import tokenize
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set
+
+__all__ = [
+    "Finding", "Rule", "ModuleContext", "register", "all_rules",
+    "lint_source", "lint_path", "lint_paths", "expr_tainted",
+    "taint_function", "closure_taint", "dotted_name", "main",
+]
+
+
+# --------------------------------------------------------------- findings
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit, pointing at a source line."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}")
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """A named check over a :class:`ModuleContext`.
+
+    Subclasses set ``name`` (the suppression key) and ``summary`` and
+    implement :meth:`check` yielding findings (suppressions are applied
+    by the runner, not the rule).
+    """
+
+    name: str = ""
+    summary: str = ""
+
+    def check(self, ctx: "ModuleContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "ModuleContext", node: ast.AST,
+                message: str) -> Finding:
+        return Finding(self.name, ctx.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0) + 1, message)
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls: type) -> type:
+    """Class decorator adding a rule to the global registry."""
+    rule = rule_cls()
+    if not rule.name:
+        raise ValueError(f"{rule_cls.__name__} has no name")
+    if rule.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    _REGISTRY[rule.name] = rule
+    return rule_cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    # rules.py self-registers on import; import lazily to avoid a cycle
+    from tools.graftlint import rules as _rules  # noqa: F401
+    return dict(_REGISTRY)
+
+
+# ----------------------------------------------------------- suppressions
+
+_DISABLE = "graftlint: disable="
+_DISABLE_FILE = "graftlint: disable-file="
+_MARK_TRACED = "graftlint: traced"
+_MARK_NOT_TRACED = "graftlint: not-traced"
+
+
+def _parse_rule_list(text: str) -> Set[str]:
+    """Comma-separated rule names; each stops at whitespace so trailing
+    commentary (``disable=env-read-in-trace — host-only value``) does
+    not silently break the suppression."""
+    rules: Set[str] = set()
+    for segment in text.split(","):
+        words = segment.strip().split()
+        if words:
+            rules.add(words[0])
+    return rules
+
+
+class _Suppressions:
+    def __init__(self) -> None:
+        self.by_line: Dict[int, Set[str]] = {}
+        self.file_wide: Set[str] = set()
+        self.traced_marks: Set[int] = set()
+        self.not_traced_marks: Set[int] = set()
+
+    @classmethod
+    def scan(cls, source: str) -> "_Suppressions":
+        sup = cls()
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                text = tok.string.lstrip("#").strip()
+                line = tok.start[0]
+                standalone = tok.line.strip().startswith("#")
+                if text.startswith(_DISABLE_FILE):
+                    sup.file_wide |= _parse_rule_list(
+                        text[len(_DISABLE_FILE):])
+                elif text.startswith(_DISABLE):
+                    rules = _parse_rule_list(text[len(_DISABLE):])
+                    target = line + 1 if standalone else line
+                    sup.by_line.setdefault(target, set()).update(rules)
+                elif text.startswith(_MARK_NOT_TRACED):
+                    sup.not_traced_marks.add(line)
+                elif text.startswith(_MARK_TRACED):
+                    sup.traced_marks.add(line)
+        except tokenize.TokenError:
+            pass
+        return sup
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_wide or "all" in self.file_wide:
+            return True
+        rules = self.by_line.get(line, ())
+        return rule in rules or "all" in rules
+
+
+# ------------------------------------------------------------ AST helpers
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_attr(node: ast.AST) -> Optional[str]:
+    """Final component of a dotted name (``jit`` for ``jax.jit``)."""
+    d = dotted_name(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+# transforms whose operand executes at trace time
+_JIT_LIKE = {"jit", "pjit", "pmap", "vmap", "grad", "value_and_grad",
+             "checkpoint", "remat", "shard_map", "custom_vjp",
+             "custom_jvp", "named_call", "xmap"}
+# control-flow combinators → positional indices of their traced
+# callables (None = every argument from the first index onward, for
+# switch's variadic branch list).  Predicates/operands at other
+# positions (cond's args[0], fori_loop's bounds) are NOT callables and
+# must not mark same-named defs traced.
+_CALLABLE_TAKER_ARGS = {
+    "scan": (0,), "map": (0,), "associative_scan": (0,),
+    "while_loop": (0, 1),          # cond_fun, body_fun
+    "cond": (1, 2),                # pred, true_fun, false_fun
+    "fori_loop": (2,),             # lower, upper, body_fun
+    "switch": None,                # index, *branches
+    "custom_root": (0, 2, 3),      # f, initial_guess, solve, tangent_solve
+    "custom_linear_solve": (0, 2, 3),  # matvec, b, solve, transpose_solve
+}
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _decorator_marks_traced(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        # @functools.partial(jax.jit, ...) / @jax.jit(...)-style factory
+        if last_attr(dec.func) == "partial" and dec.args:
+            return _decorator_marks_traced(dec.args[0])
+        return _decorator_marks_traced(dec.func)
+    la = last_attr(dec)
+    return la in _JIT_LIKE or la == "compact"
+
+
+def _is_module_class(cls: ast.ClassDef) -> bool:
+    """``class X(nn.Module)`` / ``(flax.linen.Module)`` / ``(Module)``."""
+    for base in cls.bases:
+        if last_attr(base) == "Module":
+            return True
+    return False
+
+
+class ModuleContext:
+    """Everything the rules need about one parsed file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.suppressions = _Suppressions.scan(source)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        # a standalone disable above a decorator targets the decorator
+        # line, but def-anchored findings (jit-missing-donate) point at
+        # the def — extend decorator-line suppressions to the def line
+        for node in ast.walk(tree):
+            decorators = getattr(node, "decorator_list", None)
+            if not decorators:
+                continue
+            for dec in decorators:
+                rules = self.suppressions.by_line.get(dec.lineno)
+                if rules:
+                    self.suppressions.by_line.setdefault(
+                        node.lineno, set()).update(rules)
+        self._traced: Optional[Set[ast.AST]] = None
+        self._entries: Set[ast.AST] = set()
+
+    # -- navigation ---------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, _FuncNode):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def functions(self) -> Iterator[ast.AST]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FuncNode):
+                yield node
+
+    def func_name(self, fn: ast.AST) -> str:
+        return getattr(fn, "name", "<lambda>")
+
+    # -- trace-path inference -----------------------------------------
+
+    def traced_functions(self) -> Set[ast.AST]:
+        if self._traced is None:
+            self._traced = self._infer_traced()
+        return self._traced
+
+    def traced_entries(self) -> Set[ast.AST]:
+        """Trace-path *entry points*: functions whose parameters are
+        the traced operands themselves (jit-family decorated,
+        ``nn.Module.__call__``/``@nn.compact`` methods, callables
+        passed to jit/scan/cond call sites, ``# graftlint: traced``
+        marks).  Transitively-traced same-file helpers are excluded —
+        their parameters are often static config threaded by the
+        entry, so taint-based rules seed only here."""
+        self.traced_functions()
+        return self._entries
+
+    def is_traced(self, node: ast.AST) -> bool:
+        """Is ``node`` lexically inside a trace-time function body?"""
+        fn = node if isinstance(node, _FuncNode) \
+            else self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced_functions():
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    def defines_trace_paths(self) -> bool:
+        return bool(self.traced_functions())
+
+    def owns(self, entry: ast.AST, node: ast.AST) -> bool:
+        """Does ``entry``'s walk cover ``node``?
+
+        A node belongs to its nearest enclosing traced *entry*: nested
+        non-entry defs (the jit'd train_step's inner ``loss_fn``
+        closure, scan bodies) are part of the enclosing entry's trace
+        and share its taint, while nested defs that are entries in
+        their own right are covered by their own iteration.  Lambda
+        entries are transparent (rules skip lambdas as iteration
+        roots, so their bodies must stay with the enclosing entry)."""
+        entries = self.traced_entries()
+        cur = self.enclosing_function(node)
+        while cur is not None:
+            if cur is entry:
+                return True
+            if cur in entries and not isinstance(cur, ast.Lambda):
+                return False
+            cur = self.enclosing_function(cur)
+        return False
+
+    def nested_in_entry(self, fn: ast.AST) -> bool:
+        """Is ``fn`` lexically nested inside a (non-lambda) traced
+        entry?  Such functions are covered by the entry's walk."""
+        entries = self.traced_entries()
+        cur = self.enclosing_function(fn)
+        while cur is not None:
+            if cur in entries and not isinstance(cur, ast.Lambda):
+                return True
+            cur = self.enclosing_function(cur)
+        return False
+
+    def _infer_traced(self) -> Set[ast.AST]:
+        traced: Set[ast.AST] = set()
+        opted_out: Set[ast.AST] = set()
+        # name -> defs (over-approximate: any scope in the file)
+        by_name: Dict[str, List[ast.AST]] = {}
+        for fn in self.functions():
+            if isinstance(fn, ast.Lambda):
+                continue
+            by_name.setdefault(fn.name, []).append(fn)
+
+        def mark_name(name: Optional[str]) -> None:
+            if name:
+                for fn in by_name.get(name, ()):
+                    traced.add(fn)
+
+        for node in ast.walk(self.tree):
+            # explicit comment marks on the def line
+            if isinstance(node, _FuncNode):
+                line = getattr(node, "lineno", -1)
+                if line in self.suppressions.not_traced_marks:
+                    opted_out.add(node)
+                elif line in self.suppressions.traced_marks:
+                    traced.add(node)
+            # jit-family decorators; nn.compact methods
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_decorator_marks_traced(d)
+                       for d in node.decorator_list):
+                    traced.add(node)
+            # __call__ of nn.Module subclasses
+            if isinstance(node, ast.ClassDef) and _is_module_class(node):
+                for item in node.body:
+                    if (isinstance(item, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                            and item.name == "__call__"):
+                        traced.add(item)
+            # call sites: jit(f) / lax.scan(f, ...) / checkpoint(f)
+            if isinstance(node, ast.Call):
+                la = last_attr(node.func)
+                callable_args = ()
+                if la in _JIT_LIKE:
+                    callable_args = node.args[:1]
+                elif la in _CALLABLE_TAKER_ARGS:
+                    positions = _CALLABLE_TAKER_ARGS[la]
+                    if positions is None:    # switch: index, *branches
+                        callable_args = node.args[1:]
+                    else:
+                        callable_args = [node.args[i] for i in positions
+                                         if i < len(node.args)]
+                for arg in callable_args:
+                    if isinstance(arg, ast.Name):
+                        mark_name(arg.id)
+                    elif isinstance(arg, ast.Lambda):
+                        traced.add(arg)
+
+        self._entries = set(traced) - opted_out
+
+        # transitive closure: lexical nesting + same-file bare-name
+        # calls + self.method() calls within Module classes
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(traced):
+                if fn in opted_out:
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, _FuncNode) and node is not fn \
+                            and node not in traced:
+                        traced.add(node)
+                        changed = True
+                    if isinstance(node, ast.Call):
+                        callee = None
+                        if isinstance(node.func, ast.Name):
+                            callee = node.func.id
+                        elif (isinstance(node.func, ast.Attribute)
+                              and isinstance(node.func.value, ast.Name)
+                              and node.func.value.id == "self"):
+                            callee = node.func.attr
+                        if callee:
+                            for cand in by_name.get(callee, ()):
+                                if cand not in traced:
+                                    traced.add(cand)
+                                    changed = True
+        return traced - opted_out
+
+
+# ------------------------------------------------------------ taint engine
+
+#: attribute accesses yielding static (trace-safe) python values
+SANITIZING_ATTRS = {"shape", "ndim", "dtype", "size", "aval",
+                    "sharding", "itemsize", "device", "weak_type"}
+#: calls whose result is static regardless of argument taint
+SANITIZING_CALLS = {"len", "isinstance", "hasattr", "type", "callable",
+                    "repr", "id"}
+#: annotations marking a parameter static (config, not data)
+_STATIC_ANNOTATIONS = {"bool", "int", "float", "str", "bytes"}
+
+
+def _annotation_static(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    # bool / Optional[int] / typing.Optional[str] ...
+    names = {n.id for n in ast.walk(ann) if isinstance(n, ast.Name)}
+    names |= {n.attr for n in ast.walk(ann)
+              if isinstance(n, ast.Attribute)}
+    if not names:
+        return False
+    # FooConfig-typed params are hashable static config, not arrays
+    # (the TransformerConfig/GPTConfig convention): branching on their
+    # fields specializes the trace, which is the point of config
+    if any(n.endswith("Config") for n in names):
+        return True
+    return names <= (_STATIC_ANNOTATIONS | {"Optional", "Union", "None"})
+
+
+def expr_tainted(expr: Optional[ast.AST], tainted: Set[str]) -> bool:
+    """Does ``expr`` (possibly) derive from a tainted name?"""
+    if expr is None:
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in SANITIZING_ATTRS:
+            return False
+        return expr_tainted(expr.value, tainted)
+    if isinstance(expr, ast.Call):
+        if isinstance(expr.func, ast.Name) \
+                and expr.func.id in SANITIZING_CALLS:
+            return False
+        if expr_tainted(expr.func, tainted):
+            return True
+        return any(expr_tainted(a, tainted) for a in expr.args) or \
+            any(expr_tainted(k.value, tainted) for k in expr.keywords)
+    if isinstance(expr, ast.Subscript):
+        return expr_tainted(expr.value, tainted) \
+            or expr_tainted(expr.slice, tainted)
+    if isinstance(expr, (ast.BinOp, ast.UnaryOp, ast.BoolOp,
+                         ast.Compare, ast.IfExp, ast.Tuple, ast.List,
+                         ast.Set, ast.Dict, ast.Starred, ast.JoinedStr,
+                         ast.FormattedValue, ast.Slice, ast.NamedExpr,
+                         ast.Await)):
+        return any(expr_tainted(c, tainted)
+                   for c in ast.iter_child_nodes(expr)
+                   if isinstance(c, ast.expr))
+    return False
+
+
+def _seed_params(fn: ast.AST) -> Set[str]:
+    """Parameters of a traced function treated as traced arrays.
+
+    Excluded: ``self``/``cls``, params with static-typed annotations
+    (``bool``/``int``/``str``/...), and params whose default is a
+    python literal (``deterministic=True``, ``block=1024`` — config
+    knobs, not arrays).  ``=None`` defaults stay traced (optional
+    arrays)."""
+    args = fn.args
+    seeds: Set[str] = set()
+    ordered = list(args.posonlyargs) + list(args.args)
+    defaults = list(args.defaults)
+    # align defaults with the tail of the positional list
+    pad = [None] * (len(ordered) - len(defaults))
+    for arg, default in zip(ordered, pad + defaults):
+        seeds.add(arg.arg)
+        if arg.arg in ("self", "cls"):
+            seeds.discard(arg.arg)
+        elif _annotation_static(arg.annotation):
+            seeds.discard(arg.arg)
+        elif default is not None and isinstance(default, ast.Constant) \
+                and default.value is not None:
+            seeds.discard(arg.arg)
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if _annotation_static(arg.annotation):
+            continue
+        if default is not None and isinstance(default, ast.Constant) \
+                and default.value is not None:
+            continue
+        seeds.add(arg.arg)
+    if args.vararg:
+        seeds.add(args.vararg.arg)
+    if args.kwarg:
+        seeds.add(args.kwarg.arg)
+    return seeds
+
+
+def _assign_targets(target: ast.AST) -> Iterator[str]:
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _assign_targets(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _assign_targets(target.value)
+
+
+def closure_taint(ctx: "ModuleContext", fn: ast.AST) -> Set[str]:
+    """Taint for ``fn`` including closure capture: a traced entry that
+    is lexically nested in other traced code (``jax.grad(loss_fn)``
+    inside a jit'd train_step) sees the enclosing function's arrays
+    through its closure, so their taint is unioned in."""
+    tainted = taint_function(fn)
+    cur = ctx.enclosing_function(fn)
+    while cur is not None:
+        if cur in ctx.traced_functions() \
+                and not isinstance(cur, ast.Lambda):
+            tainted |= taint_function(cur)
+        cur = ctx.enclosing_function(cur)
+    return tainted
+
+
+def taint_function(fn: ast.AST) -> Set[str]:
+    """Names tainted anywhere in ``fn`` (one forward pass, no kill).
+
+    Nested defs/lambdas are part of the same trace: their bodies see
+    the enclosing arrays through closure capture, and their own
+    parameters are traced operands (``loss_fn(p)``, scan bodies), so
+    both are seeded into one shared taint set.  Over-approximates (a
+    rebind to a static value does not clear taint, and scopes share
+    one namespace) — acceptable for a linter that supports
+    suppression."""
+    tainted = _seed_params(fn)
+    for node in ast.walk(fn):
+        if isinstance(node, _FuncNode) and node is not fn:
+            tainted |= _seed_params(node)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+
+    def visit(stmts: Iterable[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                if expr_tainted(stmt.value, tainted):
+                    for t in stmt.targets:
+                        tainted.update(_assign_targets(t))
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                if expr_tainted(stmt.value, tainted):
+                    tainted.update(_assign_targets(stmt.target))
+            elif isinstance(stmt, ast.AugAssign):
+                if expr_tainted(stmt.value, tainted):
+                    tainted.update(_assign_targets(stmt.target))
+            elif isinstance(stmt, ast.For):
+                if expr_tainted(stmt.iter, tainted):
+                    tainted.update(_assign_targets(stmt.target))
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    if item.optional_vars is not None and \
+                            expr_tainted(item.context_expr, tainted):
+                        tainted.update(
+                            _assign_targets(item.optional_vars))
+            # walrus assignments anywhere in the statement's exprs
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.NamedExpr) and \
+                        expr_tainted(node.value, tainted):
+                    tainted.update(_assign_targets(node.target))
+            # recurse into compound bodies AND nested defs (closures
+            # share the trace, so their assignments propagate taint)
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(stmt, field, None)
+                if not sub or not isinstance(sub, list):
+                    continue
+                if field == "handlers":
+                    for h in sub:
+                        visit(h.body)
+                else:
+                    visit(sub)
+
+    # two passes approximate a fixpoint for use-before-def in loops
+    visit(body)
+    visit(body)
+    return tainted
+
+
+# ---------------------------------------------------------------- running
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint python ``source``; returns unsuppressed findings."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding("parse-error", path, exc.lineno or 1,
+                        (exc.offset or 0) + 1,
+                        f"syntax error: {exc.msg}")]
+    ctx = ModuleContext(path, source, tree)
+    rules = all_rules()
+    names = set(select) if select else set(rules)
+    unknown = names - set(rules)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+    findings: List[Finding] = []
+    for name in sorted(names):
+        for f in rules[name].check(ctx):
+            if not ctx.suppressions.is_suppressed(f.rule, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_path(path: str,
+              select: Optional[Iterable[str]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path, select)
+
+
+_SKIP_DIRS = {"__pycache__", "build", "dist", ".git", ".eggs",
+              "node_modules"}
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            raise FileNotFoundError(path)
+
+
+def lint_paths(paths: Iterable[str],
+               select: Optional[Iterable[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_path(path, select))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX trace-hygiene static analyzer "
+                    "(see docs/graftlint.md)")
+    parser.add_argument("paths", nargs="*", default=["apex_tpu"],
+                        help="files or directories to lint")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--select", action="append", default=None,
+                        metavar="RULE",
+                        help="run only these rules (repeatable)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{name:26s} {rule.summary}")
+        return 0
+
+    try:
+        files = list(iter_python_files(args.paths))
+        findings = []
+        for path in files:
+            findings.extend(lint_path(path, args.select))
+    except (FileNotFoundError, ValueError) as exc:
+        print(f"graftlint: error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        status = (f"{len(findings)} finding(s)" if findings
+                  else "clean")
+        print(f"graftlint: {len(files)} file(s), {status}")
+    return 1 if findings else 0

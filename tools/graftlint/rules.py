@@ -1,0 +1,606 @@
+"""graftlint rule set — JAX/TPU trace-hygiene checks.
+
+Each rule targets a retrace / trace-time-capture hazard observed (or
+nearly shipped) in this codebase; ``docs/graftlint.md`` documents them
+with fix recipes.  Suppress a deliberate exception with
+``# graftlint: disable=<rule>`` on (or directly above) the line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from tools.graftlint.core import (
+    Finding, ModuleContext, Rule, register, dotted_name, last_attr,
+    expr_tainted, closure_taint,
+)
+
+__all__ = []  # rules self-register; nothing to import by name
+
+
+def _is_env_read(node: ast.AST) -> bool:
+    """``os.environ[...]`` / ``os.environ.get(...)`` / ``os.getenv(...)``."""
+    if isinstance(node, ast.Subscript):
+        return dotted_name(node.value) in ("os.environ", "environ")
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        if d in ("os.getenv", "getenv"):
+            return True
+        if d in ("os.environ.get", "environ.get"):
+            return True
+        # environ.get via attribute on the environ object
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("get", "__getitem__") \
+                and dotted_name(node.func.value) in ("os.environ",
+                                                     "environ"):
+            return True
+    return False
+
+
+def _jit_call_sites(ctx: ModuleContext) -> Iterator[ast.Call]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and last_attr(node.func) in ("jit", "pjit"):
+            yield node
+
+
+def _wrapped_def(ctx: ModuleContext,
+                 call: ast.Call) -> Optional[ast.AST]:
+    """The same-file ``def`` wrapped by a jit/pjit call, if resolvable."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Lambda):
+        return target
+    if isinstance(target, ast.Name):
+        for fn in ctx.functions():
+            if getattr(fn, "name", None) == target.id:
+                return fn
+    return None
+
+
+def _jitted_defs(ctx: ModuleContext):
+    """(def, jit_call_or_decorator) for every jitted function whose
+    definition is visible in this file."""
+    seen = set()
+    for fn in ctx.functions():
+        for dec in getattr(fn, "decorator_list", ()):
+            site = dec
+            if isinstance(dec, ast.Call):
+                # @partial(jax.jit, ...) — the partial call holds kwargs
+                if last_attr(dec.func) == "partial" and dec.args \
+                        and last_attr(dec.args[0]) in ("jit", "pjit"):
+                    yield fn, dec
+                    seen.add(fn)
+                    break
+                if last_attr(dec.func) in ("jit", "pjit"):
+                    yield fn, dec
+                    seen.add(fn)
+                    break
+            elif last_attr(dec) in ("jit", "pjit"):
+                yield fn, dec
+                seen.add(fn)
+                break
+    for call in _jit_call_sites(ctx):
+        fn = _wrapped_def(ctx, call)
+        if fn is not None and fn not in seen:
+            seen.add(fn)
+            yield fn, call
+
+
+# ------------------------------------------------------------------ rule 1
+
+@register
+class EnvReadInTrace(Rule):
+    """Rule 1 — ``os.environ`` read on a trace path.
+
+    The value is captured into the jaxpr at *trace* time: flipping the
+    variable later is a silent no-op (jit caches replay the old value),
+    and two processes differing only by env silently compute different
+    numerics (the ``APEX_TPU_DECODE_ATTN`` bug, ADVICE round 5).
+    """
+
+    name = "env-read-in-trace"
+    summary = ("os.environ/getenv read inside traced code — the value "
+               "is frozen into the compiled function")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        has_trace_paths = ctx.defines_trace_paths()
+        for node in ast.walk(ctx.tree):
+            if not _is_env_read(node):
+                continue
+            if ctx.is_traced(node):
+                yield self.finding(
+                    ctx, node,
+                    "environment read inside a traced function: the "
+                    "value is captured at trace time and frozen into "
+                    "every cached executable — plumb it through config "
+                    "or a static argument instead")
+            elif has_trace_paths and ctx.enclosing_function(node) is None:
+                # module-level reads in a module that defines trace
+                # paths: import-time capture — legal but worth a look
+                yield self.finding(
+                    ctx, node,
+                    "module-level environment read in a module that "
+                    "defines traced code: the value is captured at "
+                    "import time — make sure no trace path depends on "
+                    "it changing")
+
+
+# ------------------------------------------------------------------ rule 2
+
+@register
+class TracedBranch(Rule):
+    """Rule 2 — python ``if``/``while`` on a traced value.
+
+    Branching on data raises ``TracerBoolConversionError`` at best; at
+    worst (weak types, ``shape[0]`` confusion) it silently bakes one
+    branch into the program.  Use ``jnp.where``/``lax.cond``/
+    ``lax.select`` instead.
+    """
+
+    name = "traced-branch"
+    summary = ("python if/while on a value derived from traced "
+               "arguments — use jnp.where / lax.cond")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ctx.traced_entries():
+            if isinstance(fn, ast.Lambda):
+                continue
+            tainted = closure_taint(ctx, fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                # branches in nested non-entry defs (inner loss_fn
+                # closures, scan bodies) belong to this entry's trace;
+                # nested *entries* are covered by their own iteration
+                if not ctx.owns(fn, node):
+                    continue
+                if self._cond_tainted(node.test, tainted):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{'if' if isinstance(node, ast.If) else 'while'}`"
+                        " condition derives from a traced argument — "
+                        "python control flow runs at trace time; use "
+                        "jnp.where / lax.cond / lax.select")
+
+    @staticmethod
+    def _cond_tainted(test: ast.AST, tainted: set) -> bool:
+        """Taint of a branch condition, ignoring the static idioms:
+        ``x is (not) None``, isinstance/hasattr/callable checks."""
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return False
+        if isinstance(test, ast.Call) and isinstance(test.func, ast.Name) \
+                and test.func.id in ("isinstance", "hasattr", "callable"):
+            return False
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return TracedBranch._cond_tainted(test.operand, tainted)
+        if isinstance(test, ast.BoolOp):
+            return any(TracedBranch._cond_tainted(v, tainted)
+                       for v in test.values)
+        return expr_tainted(test, tainted)
+
+
+# ------------------------------------------------------------------ rule 3
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp)
+
+
+@register
+class JitUnhashableDefault(Rule):
+    """Rule 3a — jitted function with dict/list/set default args.
+
+    Mutable defaults reach jit as traced operands with a fresh identity
+    per call path, or blow up as unhashable static args — either way
+    the executable cache can never hit reliably.
+    """
+
+    name = "jit-unhashable-default"
+    summary = ("jitted function takes dict/list/set default arguments "
+               "that defeat the executable cache")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn, site in _jitted_defs(ctx):
+            args = fn.args
+            defaults = list(args.defaults) + [
+                d for d in args.kw_defaults if d is not None]
+            for d in defaults:
+                if isinstance(d, _UNHASHABLE) or (
+                        isinstance(d, ast.Call)
+                        and isinstance(d.func, ast.Name)
+                        and d.func.id in ("dict", "list", "set")):
+                    yield self.finding(
+                        ctx, d,
+                        f"jitted `{ctx.func_name(fn)}` has a mutable "
+                        "container default — unhashable as a static "
+                        "arg and identity-fresh as a traced one; pass "
+                        "it explicitly or use a frozen/hashable value")
+
+
+# ------------------------------------------------------------------ rule 3b
+
+#: parameter names that mark a train-step-shaped signature whose input
+#: buffers are conventionally dead after the call
+_DONATABLE_PARAMS = {"state", "train_state", "opt_state", "cache",
+                     "carry"}
+
+
+@register
+class JitMissingDonate(Rule):
+    """Rule 3b — train-step-shaped jit without buffer donation.
+
+    A step function that threads ``state``/``opt_state``/``cache``
+    through itself holds both the old and new copy live across the
+    call without ``donate_argnums`` — on TPU that is the difference
+    between fitting and OOMing the model (and a guaranteed extra
+    HBM copy per step).
+    """
+
+    name = "jit-missing-donate"
+    summary = ("train-step-shaped jit (state/opt_state/cache params) "
+               "without donate_argnums/donate_argnames")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn, site in _jitted_defs(ctx):
+            if isinstance(fn, ast.Lambda):
+                continue
+            params = [a.arg for a in (list(fn.args.posonlyargs)
+                                      + list(fn.args.args))]
+            hits = [p for p in params if p in _DONATABLE_PARAMS]
+            if not hits:
+                continue
+            if self._has_donate(site):
+                continue
+            yield self.finding(
+                ctx, fn,
+                f"jitted `{ctx.func_name(fn)}` threads "
+                f"`{'`/`'.join(hits)}` without donate_argnums — the "
+                "old buffers stay live across the call, doubling "
+                "their HBM footprint; donate them (or suppress if "
+                "the input really is reused afterwards)")
+
+    @staticmethod
+    def _has_donate(site: ast.AST) -> bool:
+        if isinstance(site, ast.Call):
+            return any(k.arg in ("donate_argnums", "donate_argnames")
+                       for k in site.keywords)
+        return False  # bare @jax.jit decorator has no kwargs
+
+
+# ------------------------------------------------------------------ rule 4
+
+@register
+class LruCacheHazard(Rule):
+    """Rule 4 — ``functools.lru_cache`` with a key that cannot work.
+
+    Two flavors: mutable-container defaults (raise ``TypeError:
+    unhashable`` on first call, or worse, force callers to pass
+    tuples that alias) and env-dependent bodies (the cache key omits
+    the env, so a cached entry silently outlives an env flip — the
+    ``generate()``/``_compiled_run`` + ``APEX_TPU_DECODE_ATTN``
+    interaction).
+    """
+
+    name = "lru-cache-hazard"
+    summary = ("lru_cache keyed on unhashable defaults or caching an "
+               "env-dependent result")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ctx.functions():
+            if isinstance(fn, ast.Lambda):
+                continue
+            if not self._lru_decorated(fn):
+                continue
+            args = fn.args
+            defaults = list(args.defaults) + [
+                d for d in args.kw_defaults if d is not None]
+            for d in defaults:
+                if isinstance(d, _UNHASHABLE):
+                    yield self.finding(
+                        ctx, d,
+                        f"lru_cache-wrapped `{fn.name}` has a mutable "
+                        "container default — unhashable, so the cache "
+                        "raises (or the caller aliases); use a tuple "
+                        "or hashable config object")
+            for node in ast.walk(fn):
+                if _is_env_read(node):
+                    yield self.finding(
+                        ctx, node,
+                        f"lru_cache-wrapped `{fn.name}` reads the "
+                        "environment: the env is not part of the cache "
+                        "key, so a cached entry survives an env flip — "
+                        "hoist the read to the caller and pass it as "
+                        "an argument")
+
+    @staticmethod
+    def _lru_decorated(fn: ast.AST) -> bool:
+        for dec in getattr(fn, "decorator_list", ()):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if last_attr(target) in ("lru_cache", "cache"):
+                return True
+        return False
+
+
+# ------------------------------------------------------------------ rule 5
+
+_WALLCLOCK = {"time.time", "time.perf_counter", "time.monotonic",
+              "time.time_ns", "time.perf_counter_ns",
+              "datetime.now", "datetime.utcnow",
+              "datetime.datetime.now", "datetime.datetime.utcnow"}
+
+
+@register
+class TimeInTrace(Rule):
+    """Rule 5 — wall-clock / host RNG inside traced code.
+
+    ``time.time()`` or ``np.random`` in a traced body executes ONCE at
+    trace time; every compiled replay reuses that constant — timings
+    read as zero and "random" draws repeat forever.  Use
+    ``jax.random`` with threaded keys; time around the jit boundary.
+    """
+
+    name = "time-in-trace"
+    summary = ("time.*/datetime.now/np.random inside traced code runs "
+               "once at trace time and is baked in")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not ctx.is_traced(node):
+                continue
+            d = dotted_name(node.func)
+            if d in _WALLCLOCK:
+                yield self.finding(
+                    ctx, node,
+                    f"`{d}()` inside a traced function executes once "
+                    "at trace time — the compiled function replays a "
+                    "constant; measure outside the jit boundary")
+            elif d and (d.startswith("np.random.")
+                        or d.startswith("numpy.random.")):
+                yield self.finding(
+                    ctx, node,
+                    f"`{d}()` inside a traced function draws once at "
+                    "trace time — every replay reuses the same "
+                    "values; use jax.random with an explicit key")
+
+
+# ------------------------------------------------------------------ rule 6
+
+_HOST_CONVERSIONS = {"float", "int", "bool", "complex"}
+
+
+@register
+class HostSyncInTrace(Rule):
+    """Rule 6 — host conversion of a traced value.
+
+    ``.item()`` / ``float(x)`` / ``int(x)`` on a tracer either raises
+    (``ConcretizationTypeError``) or — under ``jax.debug``-style
+    escapes — forces a device→host sync that serializes the pipeline.
+    """
+
+    name = "host-sync-in-trace"
+    summary = (".item()/float()/int() on a traced value — "
+               "concretization error or a hidden host sync")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ctx.traced_entries():
+            if isinstance(fn, ast.Lambda):
+                continue
+            tainted = closure_taint(ctx, fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not ctx.owns(fn, node):
+                    continue
+                # x.item() / jax.device_get(x) on tainted x
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" \
+                        and expr_tainted(node.func.value, tainted):
+                    yield self.finding(
+                        ctx, node,
+                        ".item() on a traced value — raises under jit; "
+                        "keep the value on device or return it")
+                    continue
+                d = dotted_name(node.func)
+                if d in ("jax.device_get", "device_get") and node.args \
+                        and expr_tainted(node.args[0], tainted):
+                    yield self.finding(
+                        ctx, node,
+                        "jax.device_get on a traced value inside a "
+                        "traced function — host sync; return the "
+                        "value instead")
+                    continue
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in _HOST_CONVERSIONS \
+                        and len(node.args) == 1 \
+                        and expr_tainted(node.args[0], tainted):
+                    yield self.finding(
+                        ctx, node,
+                        f"{node.func.id}() on a traced value — "
+                        "ConcretizationTypeError under jit; use "
+                        "jnp/astype forms that stay on device")
+
+
+# ------------------------------------------------------------------ rule 7
+
+@register
+class PrintInTrace(Rule):
+    """Rule 7 — ``print``/f-string formatting of traced values.
+
+    ``print`` in a traced body fires once at trace time (then never
+    again), and formatting a tracer prints ``Traced<...>`` garbage.
+    ``jax.debug.print`` is the in-graph equivalent.
+    """
+
+    name = "print-in-trace"
+    summary = ("print()/f-string on traced values — fires at trace "
+               "time only; use jax.debug.print")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        entries = ctx.traced_entries()
+        for fn in ctx.traced_functions():
+            if isinstance(fn, ast.Lambda):
+                continue
+            is_entry = fn in entries
+            if not is_entry and ctx.nested_in_entry(fn):
+                continue    # covered by the enclosing entry's walk
+            tainted = closure_taint(ctx, fn) if is_entry else set()
+            for node in ast.walk(fn):
+                if is_entry:
+                    if not ctx.owns(fn, node):
+                        continue
+                elif ctx.enclosing_function(node) is not fn:
+                    continue
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == "print":
+                    traced_args = any(expr_tainted(a, tainted)
+                                      for a in node.args)
+                    msg = ("print() of a traced value — prints "
+                           "`Traced<...>` once at trace time; use "
+                           "jax.debug.print"
+                           if traced_args else
+                           "print() inside a traced function fires at "
+                           "trace time only (never per step); use "
+                           "jax.debug.print or log outside the jit")
+                    yield self.finding(ctx, node, msg)
+                elif isinstance(node, ast.JoinedStr) and is_entry:
+                    # f-strings inside raise/assert are trace-time
+                    # validation — idiomatic, not a formatting bug
+                    if self._in_raise_or_assert(ctx, node):
+                        continue
+                    if any(expr_tainted(v.value, tainted)
+                           for v in node.values
+                           if isinstance(v, ast.FormattedValue)):
+                        yield self.finding(
+                            ctx, node,
+                            "f-string formats a traced value — "
+                            "stringifies the tracer at trace time; "
+                            "use jax.debug.print formatting")
+
+    @staticmethod
+    def _in_raise_or_assert(ctx: ModuleContext, node: ast.AST) -> bool:
+        cur = ctx.parent(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if isinstance(cur, (ast.Raise, ast.Assert)):
+                return True
+            cur = ctx.parent(cur)
+        return False
+
+
+# ------------------------------------------------------------------ rule 8
+
+_MUTATORS = {"append", "extend", "add", "update", "setdefault", "pop",
+             "insert", "remove", "clear", "popitem", "discard",
+             "appendleft"}
+
+
+@register
+class MutableGlobalInTrace(Rule):
+    """Rule 8 — module-level mutable state mutated from traced code.
+
+    The mutation happens once per *trace*, not once per call — counters
+    under-count, registries grow per retrace, and the compiled function
+    never sees the updated value.  Thread state functionally or keep it
+    strictly host-side.
+    """
+
+    name = "mutable-global-in-trace"
+    summary = ("module-level mutable state mutated inside traced code "
+               "— mutations run per trace, not per call")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        module_mutables = self._module_mutables(ctx)
+        for fn in ctx.traced_functions():
+            declared_global: Set[str] = {
+                name
+                for node in ast.walk(fn)
+                if isinstance(node, ast.Global)
+                for name in node.names}
+            for node in ast.walk(fn):
+                if ctx.enclosing_function(node) is not fn:
+                    continue
+                # global X; X = ... rebinding
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        if isinstance(t, ast.Name) \
+                                and t.id in declared_global:
+                            yield self.finding(
+                                ctx, node,
+                                f"rebinds global `{t.id}` inside a "
+                                "traced function — runs once per "
+                                "trace, not per call")
+                        # X[...] = ... on a module-level container
+                        elif isinstance(t, ast.Subscript) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id in module_mutables \
+                                and not self._is_local(fn, t.value.id):
+                            yield self.finding(
+                                ctx, node,
+                                f"writes into module-level container "
+                                f"`{t.value.id}` inside a traced "
+                                "function — mutation happens at trace "
+                                "time only")
+                # X.append(...) etc. on a module-level container
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATORS \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in module_mutables \
+                        and not self._is_local(fn, node.func.value.id):
+                    yield self.finding(
+                        ctx, node,
+                        f"mutates module-level container "
+                        f"`{node.func.value.id}` inside a traced "
+                        "function — mutation happens at trace time "
+                        "only; thread state functionally")
+
+    @staticmethod
+    def _module_mutables(ctx: ModuleContext) -> Set[str]:
+        names: Set[str] = set()
+        for stmt in ctx.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                targets, value = [stmt.target], stmt.value
+            else:
+                continue
+            mutable = isinstance(value, _UNHASHABLE) or (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id in ("dict", "list", "set",
+                                      "defaultdict", "deque"))
+            if not mutable:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        return names
+
+    @staticmethod
+    def _is_local(fn: ast.AST, name: str) -> bool:
+        """Shadowed by a local binding (param or assignment)?"""
+        args = fn.args
+        params = {a.arg for a in (list(args.posonlyargs) + list(args.args)
+                                  + list(args.kwonlyargs))}
+        if args.vararg:
+            params.add(args.vararg.arg)
+        if args.kwarg:
+            params.add(args.kwarg.arg)
+        if name in params:
+            return True
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return True
+        return False

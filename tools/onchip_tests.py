@@ -39,15 +39,13 @@ def main():
         cwd=root, env=env, capture_output=True, text=True,
         timeout=7200)
     dt = time.time() - t0
-    tail = (proc.stdout or "").strip().splitlines()[-1:] or [""]
-    m = re.search(r"(\d+) passed", tail[0])
-    failed = re.search(r"(\d+) failed", tail[0])
+    result_line, m, failed = _parse_summary(proc.stdout or "")
     import jax
 
     out = {
         "artifact": "on-chip kernel test pass",
         "platform_env": env["APEX_TPU_TEST_PLATFORM"],
-        "result_line": tail[0],
+        "result_line": result_line,
         "passed": int(m.group(1)) if m else 0,
         "failed": int(failed.group(1)) if failed else 0,
         "returncode": proc.returncode,
@@ -64,6 +62,25 @@ def main():
     if proc.returncode != 0:
         print(proc.stdout[-3000:], file=sys.stderr)
         sys.exit(1)
+
+
+def _parse_summary(stdout: str):
+    """Find pytest's ``N passed``/``N failed`` summary in the output tail.
+
+    On green runs pytest -q prints the summary line above trailing
+    warnings-summary / coverage chatter, so parsing only the very last
+    line recorded 0/0 for successful passes.  Scan bottom-up (no line
+    cap: a long tail must not push the summary out of reach; the
+    count patterns cannot false-match ordinary test output) for the
+    first line with a pass/fail/error count.
+    """
+    lines = stdout.strip().splitlines()
+    for line in reversed(lines):
+        m = re.search(r"(\d+) passed", line)
+        failed = re.search(r"(\d+) (?:failed|error)", line)
+        if m or failed:
+            return line, m, failed
+    return (lines[-1] if lines else ""), None, None
 
 
 def _libtpu_version():
