@@ -50,6 +50,7 @@ from apex_tpu import optim
 from apex_tpu import parallel
 from apex_tpu import transformer
 from apex_tpu import contrib
+from apex_tpu import serving
 from apex_tpu import utils
 
 __all__ = [
@@ -73,5 +74,6 @@ __all__ = [
     "parallel",
     "transformer",
     "contrib",
+    "serving",
     "utils",
 ]

@@ -24,28 +24,130 @@ batches should be bucketed by the caller) and ``max_new_tokens`` is
 static.  The compiled loop is cached per ``(model, max_new_tokens,
 temperature, top_k, eos_id, prefill_chunk)`` signature (jit handles
 the shape axis), so repeated same-shape calls do not retrace.
+
+The building blocks — :func:`apply_decode` (one cached-decode model
+application), :func:`prefill_tokens` (single-call or chunked prefill)
+and :func:`sample_logits` (greedy / temperature / top-k) — are public:
+``apex_tpu.serving`` composes them into the continuous-batching engine,
+so the two inference surfaces share one prefill and one sampling
+definition.
+
+Memoization: results are keyed on a *value signature* of the model —
+``(type(model), model.cfg)`` — never on the instance.  Flax modules
+hash and compare by field values, so an equal-config model revives a
+cached entry, and (the round-1 regression) the memos do not pin up to
+64 model instances for the process lifetime: compiled runners hold the
+model through a weakref that :func:`generate` re-binds on every call.
 """
 
 from __future__ import annotations
 
 import functools
+import weakref
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["init_cache", "generate"]
+__all__ = [
+    "init_cache",
+    "cache_shapes",
+    "generate",
+    "apply_decode",
+    "prefill_tokens",
+    "sample_logits",
+]
+
+# bound on each memo below, matching the old lru_cache(maxsize=64);
+# eviction is insertion-order (FIFO) — generation signatures are
+# long-lived, LRU precision buys nothing here
+_MEMO_MAX = 64
 
 
-@functools.lru_cache(maxsize=64)
+def _memo_put(memo: dict, key, value) -> None:
+    if key not in memo and len(memo) >= _MEMO_MAX:
+        memo.pop(next(iter(memo)))
+    memo[key] = value
+
+
+def _model_signature(model):
+    """Hashable value-identity of ``model`` that does NOT reference the
+    instance.
+
+    Flax modules hash/compare by (type, dataclass fields), so the
+    signature is ``(type, *field values)`` over every module field
+    except the tree-wiring ones (``parent``/``name``) — capturing field
+    *values* (configs, flags) keeps two equal models on one memo entry
+    without referencing either instance.  ``cfg`` alone would NOT be
+    enough: a module carrying extra fields (say a ``use_flash: bool``
+    beside its cfg) must not collide with its sibling.  A model with
+    unhashable field values (arrays) falls back to an
+    :class:`_IdentityKey`: identity-scoped, but still collectible (a
+    plain ``weakref.ref`` would not do — its hash delegates to the
+    unhashable referent — and a raw ``id()`` key could be revived by
+    an id-reusing new object after collection).
+    """
+    import dataclasses
+
+    try:
+        fields = tuple(
+            (f.name, getattr(model, f.name))
+            for f in dataclasses.fields(model)
+            if f.name not in ("parent", "name"))
+        key = (type(model),) + fields
+        hash(key)
+        return key
+    except (TypeError, AttributeError):
+        return _IdentityKey(model)
+
+
+class _IdentityKey:
+    """Identity-scoped memo key for unhashable models: hashes by
+    ``id``, compares equal only while the referent is alive and
+    identical — a dead entry can never be revived by an id-reusing
+    new object, it just ages out of the bounded memo."""
+
+    __slots__ = ("_id", "_ref")
+
+    def __init__(self, model):
+        self._id = id(model)
+        self._ref = weakref.ref(model)
+
+    def __hash__(self):
+        return self._id
+
+    def __eq__(self, other):
+        if not isinstance(other, _IdentityKey):
+            return NotImplemented
+        mine, theirs = self._ref(), other._ref()
+        return mine is not None and mine is theirs
+
+
+_shape_memo: dict = {}
+
+
 def _cache_shapes(model, batch_size: int, prompt_len: int):
     """Memoized cache structure: one abstract trace of ``model.init``
-    per (model, batch) signature — repeated generate() calls skip the
-    whole-model eval_shape."""
-    ids = jnp.zeros((batch_size, prompt_len), jnp.int32)
-    return jax.eval_shape(
-        functools.partial(model.init, decode=True),
-        jax.random.PRNGKey(0), ids)["cache"]
+    per (model-signature, batch) key — repeated generate() calls skip
+    the whole-model eval_shape."""
+    key = (_model_signature(model), batch_size, prompt_len)
+    out = _shape_memo.get(key)
+    if out is None:
+        ids = jnp.zeros((batch_size, prompt_len), jnp.int32)
+        out = jax.eval_shape(
+            functools.partial(model.init, decode=True),
+            jax.random.PRNGKey(0), ids)["cache"]
+        _memo_put(_shape_memo, key, out)
+    return out
+
+
+def cache_shapes(model, batch_size: int, *, prompt_len: int = 1):
+    """``ShapeDtypeStruct`` pytree of ``model``'s decode cache.
+
+    The abstract twin of :func:`init_cache` — ``apex_tpu.serving``
+    builds its slot-stacked cache pool from this structure.
+    """
+    return _cache_shapes(model, batch_size, prompt_len)
 
 
 def init_cache(model, batch_size: int, *, prompt_len: int = 1,
@@ -63,31 +165,121 @@ def init_cache(model, batch_size: int, *, prompt_len: int = 1,
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
-@functools.lru_cache(maxsize=64)
-def _compiled_run(model, max_new_tokens: int, temperature: float,
-                  top_k: Optional[int], eos_id: Optional[int],
-                  prefill_chunk: int = 0):
-    """One jitted prefill+scan loop per static signature.
+def apply_decode(model, variables, cache, ids):
+    """One ``decode=True`` model application over ``cache``.
 
-    ``model`` is a frozen flax module (hashable); jit's own cache
-    handles the (batch, prompt_len) shape axis on top.
+    Returns ``(logits, new_cache)``.  ``variables`` is the param dict
+    WITHOUT a ``"cache"`` entry; the cache rides separately so callers
+    can thread it functionally (scan carries, slot pools).
+    """
+    logits, upd = model.apply(
+        {**variables, "cache": cache}, ids,
+        deterministic=True, decode=True, mutable=["cache"])
+    return logits, upd["cache"]
+
+
+def prefill_tokens(model, variables, cache, prompt_ids,
+                   prefill_chunk: int = 0):
+    """Run ``prompt_ids`` (b, plen) through the decode chunk path.
+
+    Returns ``(last_logits, cache)`` with ``last_logits`` of shape
+    ``(b, vocab)`` — the logits of the final prompt position.  With
+    ``prefill_chunk`` > 0 and a longer prompt, the prompt runs as
+    fixed-size chunks through the model's decode chunk path under one
+    ``lax.scan`` (the leading remainder chunk keeps every scanned chunk
+    the same static size); only the running last-token logits ride the
+    carry, so nothing O(prompt·vocab) materializes.
+    """
+    b, plen = prompt_ids.shape
+    if prefill_chunk and plen > prefill_chunk:
+        C = prefill_chunk
+        r = plen % C or C
+        logits, cache = apply_decode(model, variables, cache,
+                                     prompt_ids[:, :r])
+        last = logits[:, -1]
+        n = (plen - r) // C
+        if n:
+            chunks = prompt_ids[:, r:].reshape(b, n, C).swapaxes(0, 1)
+
+            def pre(carry, chunk):
+                cache, _ = carry
+                lg, cache = apply_decode(model, variables, cache, chunk)
+                return (cache, lg[:, -1]), None
+
+            (cache, last), _ = jax.lax.scan(pre, (cache, last), chunks)
+        return last, cache
+    # prefill: one pass over the prompt populates every cache
+    logits, cache = apply_decode(model, variables, cache, prompt_ids)
+    return logits[:, -1], cache
+
+
+def sample_logits(logits, key, *, temperature: float,
+                  top_k: Optional[int] = None):
+    """Sample next tokens from last-position ``logits`` (b, vocab).
+
+    ``temperature`` / ``top_k`` are PYTHON statics (part of the jit
+    signature): ``temperature <= 0`` is pure fp32 argmax (no rng use),
+    otherwise logits/temperature are sampled, optionally truncated to
+    the ``top_k`` highest-scoring tokens.  The serving engine's
+    per-slot *array*-parameter variant of the same math lives in
+    ``apex_tpu.serving.engine`` (device-carried params, one executable
+    for mixed configs).
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+
+class _Runner:
+    """A compiled generate loop bound to its model *by value*.
+
+    ``run`` is the jitted prefill+scan loop; its python body resolves
+    the model through ``_ref`` at trace time, so the memo holds no
+    strong reference to any module instance (the old lru_cache pinned
+    up to 64 models for the process lifetime).  :func:`generate`
+    re-binds ``_ref`` on every call: all models mapping to one memo key
+    are value-equal, so whichever live instance is bound traces the
+    identical computation, and an entry whose original instance was
+    collected is revived by the next equal-config call.
     """
 
-    def next_token(logits, key):
-        logits = logits[:, -1].astype(jnp.float32)
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / temperature
-        if top_k is not None:
-            kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-            scaled = jnp.where(scaled < kth, -1e30, scaled)
-        return jax.random.categorical(key, scaled).astype(jnp.int32)
+    __slots__ = ("_ref", "run")
 
-    def apply(variables, cache, ids):
-        logits, upd = model.apply(
-            {**variables, "cache": cache}, ids,
-            deterministic=True, decode=True, mutable=["cache"])
-        return logits, upd["cache"]
+    def bind(self, model) -> None:
+        self._ref = weakref.ref(model)
+
+    def model(self):
+        m = self._ref()
+        if m is None:           # pragma: no cover — generate binds first
+            raise RuntimeError(
+                "generate runner traced after its model was collected; "
+                "call generate() with a live model")
+        return m
+
+
+_run_memo: dict = {}
+
+
+def _compiled_run(model, max_new_tokens: int, temperature: float,
+                  top_k: Optional[int], eos_id: Optional[int],
+                  prefill_chunk: int = 0) -> _Runner:
+    """One jitted prefill+scan loop per static signature.
+
+    Keyed on the model's value signature (see :func:`_model_signature`);
+    jit's own cache handles the (batch, prompt_len) shape axis on top.
+    """
+    key = (_model_signature(model), max_new_tokens, temperature,
+           top_k, eos_id, prefill_chunk)
+    runner = _run_memo.get(key)
+    if runner is not None:
+        runner.bind(model)
+        return runner
+    runner = _Runner()
 
     # the caller-supplied cache is freshly zero-initialized per
     # generate() call and dead after it — donate it so XLA reuses its
@@ -100,56 +292,40 @@ def _compiled_run(model, max_new_tokens: int, temperature: float,
     # silently ignored with an unusable-donation warning.
     @functools.partial(jax.jit, donate_argnums=(1,))
     def run(variables, cache, prompt_ids, rng):
-        b, plen = prompt_ids.shape
-        if prefill_chunk and plen > prefill_chunk:
-            # chunked prefill: fixed-size chunks through the model's
-            # decode chunk path under one lax.scan (the leading
-            # remainder chunk keeps every scanned chunk the same
-            # static size); only the running last-token logits ride
-            # the carry, so nothing O(prompt·vocab) materializes
-            C = prefill_chunk
-            r = plen % C or C
-            logits, cache = apply(variables, cache, prompt_ids[:, :r])
-            last = logits[:, -1]
-            n = (plen - r) // C
-            if n:
-                chunks = prompt_ids[:, r:].reshape(b, n, C).swapaxes(0, 1)
-
-                def pre(carry, chunk):
-                    cache, _ = carry
-                    lg, cache = apply(variables, cache, chunk)
-                    return (cache, lg[:, -1]), None
-
-                (cache, last), _ = jax.lax.scan(pre, (cache, last),
-                                                chunks)
-            logits = last[:, None]
-        else:
-            # prefill: one pass over the prompt populates every cache
-            logits, cache = apply(variables, cache, prompt_ids)
+        model = runner.model()
+        b = prompt_ids.shape[0]
+        last, cache = prefill_tokens(model, variables, cache,
+                                     prompt_ids, prefill_chunk)
         rng, key = jax.random.split(rng)
-        tok = next_token(logits, key)
+        tok = sample_logits(last, key, temperature=temperature,
+                            top_k=top_k)
         # eos latches only on PRODUCED tokens — a prompt-contained
         # eos_id (bos/document-separator usage) must not kill the batch
         done0 = jnp.zeros((b,), bool)
 
         def step(carry, _):
             cache, tok, done, rng = carry
-            logits, cache = apply(variables, cache, tok[:, None])
+            logits, cache = apply_decode(model, variables, cache,
+                                         tok[:, None])
             rng, key = jax.random.split(rng)
-            nxt = next_token(logits, key)
+            nxt = sample_logits(logits[:, -1], key,
+                                temperature=temperature, top_k=top_k)
             if eos_id is not None:
                 done = done | (tok == eos_id)
                 nxt = jnp.where(done, eos_id, nxt)
             return (cache, nxt, done, rng), tok
 
-        (cache, last, _, _), toks = jax.lax.scan(
+        (cache, last_tok, _, _), toks = jax.lax.scan(
             step, (cache, tok, done0, rng), None,
             length=max_new_tokens - 1)
         toks = jnp.moveaxis(toks, 0, 1)              # (b, n-1)
         return jnp.concatenate(
-            [prompt_ids, toks, last[:, None]], axis=1), cache
+            [prompt_ids, toks, last_tok[:, None]], axis=1), cache
 
-    return run
+    runner.run = run
+    runner.bind(model)
+    _memo_put(_run_memo, key, runner)
+    return runner
 
 
 def generate(model, params, prompt_ids, *, max_new_tokens: int,
@@ -198,10 +374,11 @@ def generate(model, params, prompt_ids, *, max_new_tokens: int,
             f"prefill_chunk must be >= 0, got {prefill_chunk}")
     rng = jax.random.PRNGKey(0) if rng is None else rng
     cache = init_cache(model, b)
-    run = _compiled_run(model, int(max_new_tokens), float(temperature),
-                        None if top_k is None else int(top_k),
-                        None if eos_id is None else int(eos_id),
-                        int(prefill_chunk))
+    runner = _compiled_run(
+        model, int(max_new_tokens), float(temperature),
+        None if top_k is None else int(top_k),
+        None if eos_id is None else int(eos_id),
+        int(prefill_chunk))
     # the final cache rides along purely as the donation alias target
-    ids, _final_cache = run(dict(params), cache, prompt_ids, rng)
+    ids, _final_cache = runner.run(dict(params), cache, prompt_ids, rng)
     return ids

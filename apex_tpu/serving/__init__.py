@@ -1,0 +1,38 @@
+"""apex_tpu.serving — continuous-batching TPU inference engine.
+
+Multi-tenant serving over the model zoo's ``decode=True`` KV-cache
+path: a slotted cache pool with fixed ``max_slots × max_seq_len``
+shapes (:mod:`~apex_tpu.serving.cache`), one jitted decode step with
+per-slot device-array sampling params (:mod:`~apex_tpu.serving.engine`),
+a bounded FIFO queue with slot-level admission/eviction at step
+boundaries (:mod:`~apex_tpu.serving.scheduler`), and a threaded
+submit/stream front-end (:mod:`~apex_tpu.serving.api`).  Greedy decode
+through the engine is token-identical to
+``apex_tpu.models.generate``; steady state is retrace-free and
+*enforced* so by ``tracecheck.retrace_guard``.  See docs/serving.md.
+"""
+
+from apex_tpu.serving.api import (
+    InferenceServer,
+    RequestHandle,
+    ServerClosed,
+)
+from apex_tpu.serving.engine import DEFAULT_BUCKETS, Engine
+from apex_tpu.serving.scheduler import (
+    QueueFull,
+    Request,
+    Scheduler,
+    StepEvent,
+)
+
+__all__ = [
+    "InferenceServer",
+    "RequestHandle",
+    "ServerClosed",
+    "Engine",
+    "DEFAULT_BUCKETS",
+    "Scheduler",
+    "Request",
+    "StepEvent",
+    "QueueFull",
+]

@@ -1,0 +1,288 @@
+"""Threaded front-end: submit → handle, streaming tokens, metrics.
+
+:class:`InferenceServer` owns one worker thread that runs the
+engine/scheduler loop (JAX dispatch stays single-threaded); client
+threads talk to it only through the bounded queue and per-request
+:class:`RequestHandle` streams.  Throughput / occupancy / queue-depth
+metrics flow through :class:`apex_tpu.utils.metrics.MetricsWriter`
+every ``metrics_interval`` steps, tagged with the server's step counter
+and drained in order.
+
+Usage::
+
+    server = InferenceServer(model, params, max_slots=4)
+    with server:                       # starts (and warms up) the loop
+        h = server.submit([1, 2, 3], max_new_tokens=16)
+        for tok in h.stream():         # tokens as they decode
+            ...
+        full = h.result()              # or block for the final list
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu.serving.engine import DEFAULT_BUCKETS, Engine
+from apex_tpu.serving.scheduler import QueueFull, Request, Scheduler
+from apex_tpu.utils.metrics import MetricsWriter
+
+__all__ = ["InferenceServer", "RequestHandle", "ServerClosed"]
+
+_SENTINEL = object()
+
+
+class ServerClosed(RuntimeError):
+    """Submit after shutdown, or a request cancelled by shutdown."""
+
+
+class RequestHandle:
+    """Client-side view of one in-flight request."""
+
+    def __init__(self, request: Request):
+        self._request = request
+        self._stream: "queue_mod.Queue" = queue_mod.Queue()
+        self._done = threading.Event()
+        self._cancelled = False
+
+    # ------------------------------------------------------- server side
+    def _deliver(self, token: int, finished: bool) -> None:
+        self._stream.put(int(token))
+        if finished:
+            self._stream.put(_SENTINEL)
+            self._done.set()
+
+    def _cancel(self) -> None:
+        self._cancelled = True
+        self._stream.put(_SENTINEL)
+        self._done.set()
+
+    # ------------------------------------------------------- client side
+    def stream(self, timeout: Optional[float] = None):
+        """Yield tokens as they are produced; ends at eos/budget.
+        Raises :class:`ServerClosed` if the server shut down first,
+        ``TimeoutError`` if no token arrives within ``timeout``."""
+        while True:
+            try:
+                item = self._stream.get(timeout=timeout)
+            except queue_mod.Empty:
+                raise TimeoutError(
+                    f"no token within {timeout}s") from None
+            if item is _SENTINEL:
+                if self._cancelled:
+                    raise ServerClosed(
+                        "server shut down before the request finished")
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until finished; returns every produced token."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("request still decoding")
+        if self._cancelled:
+            raise ServerClosed(
+                "server shut down before the request finished")
+        return list(self._request.tokens)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def tokens_so_far(self) -> List[int]:
+        return list(self._request.tokens)
+
+
+class InferenceServer:
+    """Continuous-batching inference server over one model.
+
+    ``submit`` blocks (bounded backpressure) while the queue is full —
+    pass ``block=False`` to get :class:`QueueFull` immediately.
+    ``shutdown(wait=True)`` serves everything already accepted, then
+    stops; ``wait=False`` cancels queued AND in-flight requests (their
+    handles raise :class:`ServerClosed`).
+    """
+
+    def __init__(self, model, params, *, max_slots: int = 4,
+                 prompt_buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 prefill_chunk: int = 0, queue_capacity: int = 64,
+                 metrics: Optional[MetricsWriter] = None,
+                 metrics_interval: int = 32):
+        self.engine = Engine(
+            model, params, max_slots=max_slots,
+            prompt_buckets=prompt_buckets, prefill_chunk=prefill_chunk)
+        self.scheduler = Scheduler(self.engine,
+                                   queue_capacity=queue_capacity)
+        self.metrics = metrics
+        self.metrics_interval = max(1, int(metrics_interval))
+        self._handles: dict = {}          # uid -> RequestHandle
+        self._wakeup = threading.Condition()
+        self._stop = False
+        self._drain_on_stop = True
+        self._thread: Optional[threading.Thread] = None
+        self._steps = 0
+        self._tokens_emitted = 0
+        self._window_tokens = 0
+        self._window_t0: Optional[float] = None
+        self._last_emit_step = -1
+        #: the exception that killed the worker loop, if any — clients
+        #: see ServerClosed; the root cause lives here for post-mortems
+        self.error: Optional[BaseException] = None
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self, *, warmup: bool = True) -> "InferenceServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        if warmup:
+            self.engine.warmup()
+        self._thread = threading.Thread(
+            target=self._serve, name="apex-tpu-serving", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self, *, wait: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        if self._thread is None:
+            return
+        with self._wakeup:
+            self._stop = True
+            self._drain_on_stop = wait
+            self._wakeup.notify_all()
+        self._thread.join(timeout)
+        self._thread = None
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # propagate client-side errors without hanging on a full drain
+        self.shutdown(wait=exc_type is None)
+
+    # ------------------------------------------------------------- intake
+    def submit(self, prompt, *, max_new_tokens: int,
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               eos_id: Optional[int] = None, seed: int = 0,
+               block: bool = True,
+               timeout: Optional[float] = None) -> RequestHandle:
+        """Enqueue one request; returns its :class:`RequestHandle`."""
+        request = Request(
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature),
+            top_k=top_k, eos_id=eos_id, seed=int(seed))
+        # the handle must be reachable by the worker BEFORE the request
+        # enters the queue: run_step doesn't take _wakeup, so a fast
+        # worker can admit — even finish — a one-token request between
+        # the enqueue and any later registration, and its events would
+        # be dropped.  Keyed by object identity (stable pre-enqueue;
+        # uid is only assigned inside scheduler.submit).
+        handle = RequestHandle(request)
+        self._handles[id(request)] = handle
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while True:
+                with self._wakeup:
+                    if self._stop or self._thread is None:
+                        raise ServerClosed("server is not running")
+                    try:
+                        self.scheduler.submit(request)
+                        self._wakeup.notify_all()
+                        return handle
+                    except QueueFull:
+                        if not block:
+                            raise
+                        remaining = None if deadline is None \
+                            else deadline - time.monotonic()
+                        if remaining is not None and remaining <= 0:
+                            raise
+                        # woken by the worker after each admission wave
+                        self._wakeup.wait(
+                            0.05 if remaining is None
+                            else min(0.05, remaining))
+        except BaseException:
+            self._handles.pop(id(request), None)
+            raise
+
+    # ------------------------------------------------------------- worker
+    def _serve(self) -> None:
+        try:
+            while True:
+                with self._wakeup:
+                    while (not self.scheduler.has_work()
+                           and not self._stop):
+                        self._wakeup.wait(0.1)
+                    if self._stop and (not self._drain_on_stop
+                                       or not self.scheduler.has_work()):
+                        break
+                events = self.scheduler.run_step()
+                self._steps += 1
+                now = time.monotonic()
+                if self._window_t0 is None:
+                    self._window_t0 = now
+                for ev in events:
+                    self._tokens_emitted += 1
+                    self._window_tokens += 1
+                    handle = self._handles.get(id(ev.request))
+                    if handle is not None:
+                        handle._deliver(ev.token, ev.finished)
+                        if ev.finished:
+                            self._handles.pop(id(ev.request), None)
+                with self._wakeup:
+                    self._wakeup.notify_all()   # queue space freed
+                if self.metrics is not None \
+                        and self._steps % self.metrics_interval == 0:
+                    self._emit_metrics(now)
+        except BaseException as exc:    # noqa: BLE001 — any engine
+            # failure (RetraceError, OOM, ...) must not strand clients:
+            # record it, flip _stop so submit()/blocking waiters see a
+            # closed server, and fall through to the cancel path below
+            self.error = exc
+            with self._wakeup:
+                self._stop = True
+                self._wakeup.notify_all()
+        finally:
+            # cancel every leftover queued/in-flight handle (normal
+            # wait=False shutdown reaches here too; after a full drain
+            # there is simply nothing left to cancel)
+            for req in self.scheduler.cancel_queued():
+                handle = self._handles.pop(id(req), None)
+                if handle is not None:
+                    handle._cancel()
+            for slot, req in enumerate(self.scheduler._slots):
+                if req is None:
+                    continue
+                if self.error is None:
+                    self.engine.release(slot)
+                self.scheduler._slots[slot] = None
+                handle = self._handles.pop(id(req), None)
+                if handle is not None:
+                    handle._cancel()
+            if self.metrics is not None \
+                    and self._steps != self._last_emit_step:
+                self._emit_metrics(time.monotonic())
+
+    def _emit_metrics(self, now: float) -> None:
+        dt = max(now - (self._window_t0 or now), 1e-9)
+        self.metrics(self._steps, {
+            "tokens_per_sec": self._window_tokens / dt,
+            "occupancy": self.scheduler.occupancy,
+            "queue_depth": self.scheduler.queue_depth,
+            "tokens_total": self._tokens_emitted,
+        })
+        self.metrics.drain()
+        self._last_emit_step = self._steps
+        self._window_tokens = 0
+        self._window_t0 = now
+
+    # ---------------------------------------------------------- telemetry
+    @property
+    def steps(self) -> int:
+        return self._steps
+
+    @property
+    def tokens_emitted(self) -> int:
+        return self._tokens_emitted

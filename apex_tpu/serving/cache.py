@@ -1,0 +1,197 @@
+"""Slotted KV-cache pool — the static-shape substrate of the engine.
+
+Continuous batching needs per-sequence cache state (each tenant sits at
+its own decode position), but TPU-friendly programs need *one* set of
+shapes for the process lifetime.  The resolution: the model's per-slot
+decode cache (the ``init_cache`` pytree at batch=1) is stacked along a
+new leading **slot** axis into a ``(max_slots, ...)`` pool, and every
+mutation is a functional scatter at a *traced* slot index — admission
+overwrites one slot row, eviction zeroes it, decode advances all rows
+together.  Shapes never change: one compiled executable serves any mix
+of tenants.
+
+Per-slot scalar bookkeeping (active mask, next token, produced count,
+token budget, sampling params, rng key) lives in :class:`SlotState` —
+plain ``(max_slots,)`` device arrays carried through the jitted step,
+NOT static jit arguments, so heterogeneous sampling configs share one
+executable (the ISSUE 2 tentpole contract).
+
+Only the **dense** cache layout is supported: the rolling ring-buffer
+cache of sliding-window models keys visibility off per-slot positions,
+which the engine's rewind-on-admit trick (see
+:func:`rewind_index_leaves`) cannot restate; :func:`validate_cache_tree`
+rejects it loudly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "SlotState",
+    "init_slot_state",
+    "validate_cache_tree",
+    "stacked_zeros",
+    "zeros_from_shapes",
+    "write_slot",
+    "reset_slot",
+    "rewind_index_leaves",
+]
+
+# cache leaves that hold *positions* rather than keys/values: the
+# per-layer attention write cursor and (learned-position models) the
+# model-level position cursor.  rewind_index_leaves targets these.
+_INDEX_LEAF_NAMES = ("cache_index", "position_index")
+
+# ring-buffer-only leaf: its presence marks a sliding-window cache
+_RING_LEAF = "slot_positions"
+
+
+def _leaf_name(path) -> str:
+    """Last key of a tree path (DictKey / GetAttrKey / SequenceKey)."""
+    last = path[-1]
+    for attr in ("key", "name", "idx"):
+        val = getattr(last, attr, None)
+        if val is not None:
+            return str(val)
+    return str(last)
+
+
+def validate_cache_tree(shapes: Any) -> None:
+    """Reject cache structures the slot pool cannot manage.
+
+    ``shapes``: the per-slot cache as ShapeDtypeStructs (from
+    ``apex_tpu.models.generate.cache_shapes(model, 1)``).  Raises
+    ``ValueError`` for ring-buffer (sliding-window) caches.
+    """
+    leaves = jax.tree_util.tree_flatten_with_path(shapes)[0]
+    for path, _leaf in leaves:
+        if _leaf_name(path) == _RING_LEAF:
+            raise ValueError(
+                "the serving engine requires the dense KV-cache layout; "
+                "this model uses the sliding-window ring-buffer cache "
+                f"(found a {_RING_LEAF!r} leaf).  Serve sliding-window "
+                "models with sliding_window=None (or >= max_seq_len) — "
+                "the dense cache computes the same function whenever "
+                "sequences stay within the window")
+
+
+def stacked_zeros(shapes: Any, max_slots: int) -> Any:
+    """All-zero slot pool: each per-slot leaf gains a leading
+    ``(max_slots,)`` axis.  Zeros ARE the initialized cache (the
+    ``init_cache`` zeros-from-shape invariant)."""
+    return jax.tree.map(
+        lambda s: jnp.zeros((max_slots,) + tuple(s.shape), s.dtype),
+        shapes)
+
+
+def zeros_from_shapes(shapes: Any) -> Any:
+    """One slot's fresh zero cache (used inside the jitted prefill)."""
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def write_slot(pool: Any, slot, one: Any) -> Any:
+    """Scatter a per-slot cache into row ``slot`` of the pool
+    (traceable; ``slot`` is a traced scalar, so admission into any slot
+    replays one compiled executable)."""
+    return jax.tree.map(lambda big, small: big.at[slot].set(small),
+                        pool, one)
+
+
+def reset_slot(pool: Any, slot) -> Any:
+    """Zero row ``slot`` (eviction hygiene: stale K/V never outlives
+    its tenant, even though admission fully overwrites the row)."""
+    return jax.tree.map(
+        lambda big: big.at[slot].set(jnp.zeros_like(big[slot])), pool)
+
+
+def rewind_index_leaves(cache: Any, position) -> Any:
+    """Set every index leaf (``cache_index`` / ``position_index``) to
+    ``position``, leaving K/V leaves untouched.
+
+    The admission trick: a prompt right-padded to its bucket prefills
+    positions ``[0, bucket)``; rewinding the cursors to
+    ``true_len - 1`` makes the next decode step re-feed the last real
+    prompt token at its true position.  Pad K/V beyond the cursor is
+    invisible — cache attention masks positions ``> index``, and every
+    later token overwrites its slot before attending — so the padded
+    prefill computes exactly the unpadded function.
+    """
+    pos = jnp.asarray(position, jnp.int32)
+
+    def fix(path, leaf):
+        if _leaf_name(path) in _INDEX_LEAF_NAMES:
+            return jnp.full(leaf.shape, pos, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+class SlotState(NamedTuple):
+    """Per-slot device state — ``(max_slots,)`` arrays, one pytree.
+
+    Sampling params ride here as DEVICE ARRAYS (not static jit args):
+    a slot decoding greedily and a slot sampling at ``temperature=1.2,
+    top_k=40`` run in the same compiled step.  Conventions:
+    ``top_k == 0`` disables truncation, ``eos_id == -1`` disables eos
+    stopping, and ``rng`` is a per-slot PRNG key so a request's sampled
+    tokens are a function of its own seed, independent of co-tenants.
+    """
+
+    active: jax.Array        # bool  — slot occupied
+    tok: jax.Array           # int32 — next token to feed
+    produced: jax.Array      # int32 — tokens produced so far
+    budget: jax.Array        # int32 — max_new_tokens for the tenant
+    temperature: jax.Array   # float32
+    top_k: jax.Array         # int32 — 0 = disabled
+    eos_id: jax.Array        # int32 — -1 = disabled
+    rng: jax.Array           # uint32 (max_slots, 2) — per-slot key
+
+
+def init_slot_state(max_slots: int) -> SlotState:
+    """All-free slot state (inactive slots decode garbage that is
+    ignored on the host and overwritten at admission)."""
+    z = lambda dt: jnp.zeros((max_slots,), dt)   # noqa: E731
+    return SlotState(
+        active=z(bool),
+        tok=z(jnp.int32),
+        produced=z(jnp.int32),
+        budget=jnp.ones((max_slots,), jnp.int32),
+        temperature=z(jnp.float32),
+        top_k=z(jnp.int32),
+        eos_id=jnp.full((max_slots,), -1, jnp.int32),
+        rng=jnp.zeros((max_slots, 2), jnp.uint32),
+    )
+
+
+def admit_slot(state: SlotState, slot, tok, budget, temperature,
+               top_k, eos_id, seed) -> SlotState:
+    """Functional admission of one tenant into ``slot`` (traceable).
+
+    ``seed`` derives the slot's private PRNG key inside the trace, so
+    admission stays a single compiled executable for any seed.
+    """
+    key = jax.random.PRNGKey(seed)
+    if key.dtype != jnp.uint32:      # typed-key jax: store the raw bits
+        key = jax.random.key_data(key)
+    return state._replace(
+        active=state.active.at[slot].set(True),
+        tok=state.tok.at[slot].set(tok),
+        produced=state.produced.at[slot].set(0),
+        budget=state.budget.at[slot].set(budget),
+        temperature=state.temperature.at[slot].set(temperature),
+        top_k=state.top_k.at[slot].set(top_k),
+        eos_id=state.eos_id.at[slot].set(eos_id),
+        rng=state.rng.at[slot].set(key.astype(jnp.uint32)),
+    )
+
+
+def release_slot(state: SlotState, slot) -> SlotState:
+    """Mark ``slot`` free (traceable)."""
+    return state._replace(active=state.active.at[slot].set(False))
+
+
+__all__ += ["admit_slot", "release_slot"]

@@ -1,0 +1,317 @@
+"""Continuous-batching decode engine over the slotted KV-cache pool.
+
+One model, ``max_slots`` concurrent tenants, four compiled
+executables for the engine's whole lifetime:
+
+- ``decode_step``  — ONE trace: vmap over slots of the model's
+  ``decode=True`` single-token path, followed by branchless per-slot
+  sampling whose parameters (temperature / top_k / eos / budget) are
+  device arrays in :class:`~apex_tpu.serving.cache.SlotState` — mixed
+  sampling configs share the executable.
+- ``prefill``      — one trace PER PROMPT BUCKET: the prompt, right-
+  padded to its bucket length, runs through the shared chunked-prefill
+  path (``apex_tpu.models.generate.prefill_tokens``) into a fresh
+  per-slot cache, whose cursors are then rewound to ``true_len - 1``
+  so the first decode step re-feeds the last real prompt token (pad
+  K/V beyond the cursor is masked, then overwritten — the padded
+  prefill computes exactly the unpadded function).
+- ``admit``        — ONE trace: scatter the prefilled slot cache +
+  tenant params into the pool at a traced slot index.
+- ``release``      — ONE trace: zero the slot row, clear the active bit.
+
+Every executable is wrapped in
+:func:`apex_tpu.utils.tracecheck.retrace_guard` with exactly that
+budget, so a shape or signature leak raises ``RetraceError`` instead of
+silently recompiling per request — the engine *enforces* its own
+zero-retrace steady state rather than merely promising it.
+
+Greedy decoding through the engine is token-identical to
+``generate()``: same prefill path, same fp32 argmax; the refeed step
+recomputes the last prompt position's K/V bit-compatibly up to
+blocked-vs-einsum accumulation order (≈1e-7 — far below argmax
+resolution on real logits).
+
+The step boundary is the only device→host sync: ``step()`` returns the
+per-slot tokens and finished flags as numpy so the scheduler can evict
+and refill.  Inactive slots still compute (static shapes — no dynamic
+batch); their outputs are ignored on the host and their slot rows are
+fully rebuilt at the next admission.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.generate import (
+    apply_decode,
+    cache_shapes,
+    prefill_tokens,
+)
+from apex_tpu.serving import cache as slot_cache
+from apex_tpu.utils import tracecheck
+
+__all__ = ["Engine", "sample_dynamic", "DEFAULT_BUCKETS"]
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (32, 128, 512)
+
+
+def sample_dynamic(logits, keys, temperature, top_k, vocab_size: int):
+    """Branchless per-row sampling with DEVICE-ARRAY parameters.
+
+    ``logits`` (rows, vocab); ``keys`` (rows, 2) uint32; ``temperature``
+    / ``top_k`` (rows,).  Per row: fp32 argmax when ``temperature <= 0``
+    else top-k-truncated categorical at ``logits/temperature``
+    (``top_k == 0`` disables truncation).  The math mirrors
+    ``generate``'s static :func:`~apex_tpu.models.generate.sample_logits`
+    — kth-largest threshold on the scaled logits, ``-1e30`` mask — but
+    every parameter is traced, so one executable serves any mix.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / safe_t
+    k = jnp.where(top_k > 0, top_k, vocab_size)          # (rows,)
+    ordered = jnp.sort(scaled, axis=-1)                  # ascending
+    kth = jnp.take_along_axis(
+        ordered, (vocab_size - k)[:, None], axis=-1)     # k-th largest
+    scaled = jnp.where(scaled < kth, -1e30, scaled)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    sampled = sampled.astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+class Engine:
+    """Multi-tenant KV-cached decode over one model.
+
+    Host API (single-threaded — callers serialize; the
+    ``apex_tpu.serving.api`` server owns one engine per worker thread):
+
+    - ``admit(slot, prompt, *, max_new_tokens, ...)`` — prefill +
+      install one request into a free slot.
+    - ``step()`` — decode every slot one token; returns
+      ``(tokens, finished)`` numpy arrays of length ``max_slots``
+      (only slots the caller knows to be occupied carry meaning).
+    - ``release(slot)`` — zero + free a slot.
+    - ``warmup()`` — trace all executables (one dummy request per
+      prompt bucket) so steady state is retrace-free from request one.
+
+    ``prompt_buckets`` quantizes prompt lengths: a prompt compiles
+    nothing new as long as its length fits an existing bucket, so the
+    compile count is ``len(buckets) + 3`` for the process lifetime.
+    """
+
+    def __init__(self, model, params, *, max_slots: int = 4,
+                 prompt_buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 prefill_chunk: int = 0):
+        cfg = getattr(model, "cfg", None)
+        if cfg is None or not hasattr(cfg, "max_seq_len"):
+            raise ValueError(
+                "Engine needs a model with a .cfg carrying max_seq_len "
+                "and vocab_size (GPTModel / LlamaModel contract)")
+        if not getattr(cfg, "causal", True):
+            raise ValueError("Engine requires a causal model "
+                             "(decode=True contract)")
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}")
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(cfg.max_seq_len)
+        self.vocab_size = int(cfg.vocab_size)
+        buckets = sorted({int(b) for b in prompt_buckets})
+        if not buckets or buckets[0] < 1:
+            raise ValueError(
+                f"prompt_buckets must be positive, got {prompt_buckets}")
+        if buckets[-1] >= self.max_seq_len:
+            # == is useless too: a max_seq_len prompt has no cache room
+            # left to generate even one token
+            raise ValueError(
+                f"largest prompt bucket ({buckets[-1]}) must be < "
+                f"max_seq_len ({self.max_seq_len}) — the cache must "
+                f"hold prompt + generated tokens")
+        self.prompt_buckets = tuple(buckets)
+        self._prefill_chunk = int(prefill_chunk)
+        self._variables = dict(params)
+        if "cache" in self._variables:
+            raise ValueError(
+                "params must not carry a 'cache' collection — the "
+                "engine owns the cache pool")
+        self._shapes = cache_shapes(model, 1)
+        slot_cache.validate_cache_tree(self._shapes)
+        self.cache = slot_cache.stacked_zeros(self._shapes, max_slots)
+        self.state = slot_cache.init_slot_state(max_slots)
+        self._build()
+
+    # ------------------------------------------------------------- jits
+    def _build(self) -> None:
+        model = self.model
+        shapes = self._shapes
+        vocab = self.vocab_size
+        prefill_chunk = self._prefill_chunk
+
+        def decode_step(variables, pool, state):
+            # one token for every slot: vmap of the b=1 decode path
+            # over the slot axis — per-slot cache cursors make each
+            # row attend at its own position (the scalar cache_index
+            # of the plain batched path advances in lockstep and
+            # cannot express ragged tenants)
+            def one_slot(cache_i, tok_i):
+                logits, cache_o = apply_decode(
+                    model, variables, cache_i, tok_i[None, None])
+                return logits[0, -1], cache_o
+
+            logits, pool = jax.vmap(one_slot)(pool, state.tok)
+            split = jax.vmap(jax.random.split)(state.rng)
+            nxt = sample_dynamic(logits, split[:, 0],
+                                 state.temperature, state.top_k, vocab)
+            produced = state.produced + state.active.astype(jnp.int32)
+            hit_budget = produced >= state.budget
+            hit_eos = (state.eos_id >= 0) & (nxt == state.eos_id)
+            finished = state.active & (hit_budget | hit_eos)
+            state = state._replace(
+                tok=jnp.where(state.active, nxt, state.tok),
+                produced=produced,
+                active=state.active & ~finished,
+                rng=split[:, 1])
+            return pool, state, nxt, finished
+
+        def prefill(variables, prompt, true_len):
+            # prompt: (1, bucket_len) right-padded; true_len: traced
+            fresh = slot_cache.zeros_from_shapes(shapes)
+            _last, filled = prefill_tokens(
+                model, variables, fresh, prompt, prefill_chunk)
+            return slot_cache.rewind_index_leaves(filled, true_len - 1)
+
+        def admit(pool, state, slot, one, tok, budget, temperature,
+                  top_k, eos_id, seed):
+            pool = slot_cache.write_slot(pool, slot, one)
+            state = slot_cache.admit_slot(
+                state, slot, tok, budget, temperature, top_k, eos_id,
+                seed)
+            return pool, state
+
+        def release(pool, state, slot):
+            return (slot_cache.reset_slot(pool, slot),
+                    slot_cache.release_slot(state, slot))
+
+        # exact retrace budgets: ANY excess trace raises RetraceError —
+        # the engine's zero-retrace steady state is enforced, not
+        # aspirational.  The pool/state threads through with donation
+        # (two live copies of max_slots × max_seq_len K/V would double
+        # the engine's HBM footprint).
+        self._step = tracecheck.retrace_guard(
+            decode_step, max_traces=1, name="serving.decode_step",
+            donate_argnums=(1, 2))
+        self._prefill = tracecheck.retrace_guard(
+            prefill, max_traces=len(self.prompt_buckets),
+            name="serving.prefill")
+        self._admit = tracecheck.retrace_guard(
+            admit, max_traces=1, name="serving.admit",
+            donate_argnums=(0, 1))
+        self._release = tracecheck.retrace_guard(
+            release, max_traces=1, name="serving.release",
+            donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------- host
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest configured bucket holding ``prompt_len`` tokens."""
+        for b in self.prompt_buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt of {prompt_len} tokens exceeds the largest "
+            f"prompt bucket ({self.prompt_buckets[-1]}); configure "
+            f"larger prompt_buckets")
+
+    def validate_request(self, prompt_len: int, max_new_tokens: int,
+                         temperature: float = 0.0,
+                         top_k: Optional[int] = None) -> int:
+        """Static admission checks; returns the prompt's bucket."""
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        bucket = self.bucket_for(prompt_len)
+        if prompt_len + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt_len ({prompt_len}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({self.max_seq_len})")
+        if top_k is not None and top_k != 0 \
+                and not 1 <= top_k <= self.vocab_size:
+            raise ValueError(
+                f"top_k must be in [1, vocab_size={self.vocab_size}] "
+                f"(or 0/None to disable), got {top_k}")
+        del temperature      # any float is admissible (<=0 -> greedy)
+        return bucket
+
+    def admit(self, slot: int, prompt, *, max_new_tokens: int,
+              temperature: float = 0.0, top_k: Optional[int] = None,
+              eos_id: Optional[int] = None, seed: int = 0) -> None:
+        """Prefill ``prompt`` (1-D int tokens) and install it in
+        ``slot``.  The caller owns slot accounting (the scheduler's
+        host-side table); admitting over an occupied slot silently
+        replaces the tenant."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        bucket = self.validate_request(
+            prompt.shape[0], max_new_tokens, temperature, top_k)
+        if not 0 <= slot < self.max_slots:
+            raise ValueError(
+                f"slot must be in [0, {self.max_slots}), got {slot}")
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :prompt.shape[0]] = prompt
+        one = self._prefill(self._variables, jnp.asarray(padded),
+                            np.int32(prompt.shape[0]))
+        self.cache, self.state = self._admit(
+            self.cache, self.state, np.int32(slot), one,
+            np.int32(prompt[-1]), np.int32(max_new_tokens),
+            np.float32(temperature), np.int32(top_k or 0),
+            np.int32(-1 if eos_id is None else eos_id),
+            np.uint32(seed))
+
+    def step(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Decode one token for every slot.
+
+        Returns ``(tokens, finished)`` — numpy, length ``max_slots``.
+        ``finished[i]`` latches when slot i produced its eos or spent
+        its budget this step (the slot is already marked free on
+        device; the caller should :meth:`release` it to zero the row).
+        The single per-step host sync lives here.
+        """
+        self.cache, self.state, toks, finished = self._step(
+            self._variables, self.cache, self.state)
+        return np.asarray(toks), np.asarray(finished)
+
+    def release(self, slot: int) -> None:
+        """Zero and free ``slot``."""
+        self.cache, self.state = self._release(
+            self.cache, self.state, np.int32(slot))
+
+    def warmup(self) -> None:
+        """Trace every executable up front: one dummy tenant per
+        prompt bucket through admit → step → release.  After this, a
+        steady-state soak over any request mix triggers zero retraces
+        (and the retrace guards would raise if it did)."""
+        for bucket in self.prompt_buckets:
+            self.admit(0, np.zeros((bucket,), np.int32),
+                       max_new_tokens=1)
+            self.step()
+            self.release(0)
+
+    @property
+    def trace_counts(self) -> dict:
+        """Observed traces per executable (diagnostics / tests)."""
+        return {
+            "decode_step": self._step.trace_count,
+            "prefill": self._prefill.trace_count,
+            "admit": self._admit.trace_count,
+            "release": self._release.trace_count,
+        }

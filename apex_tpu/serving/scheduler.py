@@ -1,0 +1,176 @@
+"""Continuous batching: bounded FIFO queue + slot-level admission.
+
+The scheduler owns the host-side view the device never needs: which
+request occupies which slot, what has been emitted, and who is waiting.
+At every step boundary it (1) refills free slots from the queue in FIFO
+order — prompts quantized to the engine's length buckets so admission
+replays compiled prefills — then (2) runs one engine decode step and
+routes each produced token to its request, evicting tenants that
+finished (eos or budget).  Requests never wait for each other's
+completion: a 512-token generation and a 3-token one share the batch,
+and the short one's slot is re-used the step after it finishes — the
+continuous-batching property that fixed-batch ``generate()`` lacks.
+
+Thread-safety: ``submit`` may be called from any thread (the queue has
+its own lock); ``run_step`` must be called from the single thread that
+owns the engine (``apex_tpu.serving.api.InferenceServer``'s worker).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Request", "Scheduler", "QueueFull", "StepEvent"]
+
+
+class QueueFull(RuntimeError):
+    """The bounded request queue is at capacity."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (host object).
+
+    ``top_k=None``/``0`` disables truncation, ``eos_id=None`` disables
+    eos stopping, ``seed`` derives the request's private sampling key
+    (tokens are a function of the request, not of its co-tenants).
+    """
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    eos_id: Optional[int] = None
+    seed: int = 0
+    uid: int = -1                       # assigned by the scheduler
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass(frozen=True)
+class StepEvent:
+    """One token routed to one request at a step boundary."""
+
+    request: Request
+    token: int
+    finished: bool
+
+
+class Scheduler:
+    """Bounded-queue continuous batcher over one
+    :class:`~apex_tpu.serving.engine.Engine`."""
+
+    def __init__(self, engine, *, queue_capacity: int = 64):
+        if queue_capacity < 1:
+            raise ValueError(
+                f"queue_capacity must be >= 1, got {queue_capacity}")
+        self.engine = engine
+        self.queue_capacity = int(queue_capacity)
+        self._queue: Deque[Request] = deque()
+        self._lock = threading.Lock()
+        self._uid = itertools.count()
+        # host shadow of slot occupancy — the device active mask is
+        # never read back outside step()
+        self._slots: List[Optional[Request]] = [None] * engine.max_slots
+
+    # ------------------------------------------------------------ intake
+    def submit(self, request: Request) -> Request:
+        """Enqueue (FIFO); raises :class:`QueueFull` at capacity and
+        ``ValueError`` for requests the engine can never admit (the
+        check runs HERE so a doomed request fails at submit time, not
+        inside the serving loop)."""
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        self.engine.validate_request(
+            prompt.shape[0], request.max_new_tokens,
+            request.temperature, request.top_k)
+        request.prompt = prompt
+        with self._lock:
+            if len(self._queue) >= self.queue_capacity:
+                raise QueueFull(
+                    f"request queue at capacity "
+                    f"({self.queue_capacity}); retry after a drain")
+            request.uid = next(self._uid)
+            self._queue.append(request)
+        return request
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    @property
+    def occupancy(self) -> float:
+        return self.active_count / self.engine.max_slots
+
+    def has_work(self) -> bool:
+        return self.active_count > 0 or self.queue_depth > 0
+
+    # ------------------------------------------------------------- steps
+    def _admit_from_queue(self) -> int:
+        """Fill free slots FIFO; returns the number admitted."""
+        admitted = 0
+        for slot, occupant in enumerate(self._slots):
+            if occupant is not None:
+                continue
+            with self._lock:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            self.engine.admit(
+                slot, req.prompt,
+                max_new_tokens=req.max_new_tokens,
+                temperature=req.temperature,
+                top_k=req.top_k or 0,
+                eos_id=req.eos_id,
+                seed=req.seed)
+            self._slots[slot] = req
+            admitted += 1
+        return admitted
+
+    def run_step(self) -> List[StepEvent]:
+        """One step boundary: admit → decode → route/evict.
+
+        Returns the tokens produced this step (empty when idle).  Call
+        from the engine-owning thread only.
+        """
+        self._admit_from_queue()
+        if self.active_count == 0:
+            return []
+        tokens, finished = self.engine.step()
+        events: List[StepEvent] = []
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            tok = int(tokens[slot])
+            fin = bool(finished[slot])
+            req.tokens.append(tok)
+            events.append(StepEvent(req, tok, fin))
+            if fin:
+                self.engine.release(slot)
+                self._slots[slot] = None
+        return events
+
+    def drain(self) -> List[StepEvent]:
+        """Run steps until queue and slots are empty; returns every
+        event in emission order (synchronous convenience for tests and
+        batch scripts — the threaded server streams instead)."""
+        events: List[StepEvent] = []
+        while self.has_work():
+            events.extend(self.run_step())
+        return events
+
+    def cancel_queued(self) -> List[Request]:
+        """Drop every not-yet-admitted request (server shutdown path)."""
+        with self._lock:
+            dropped = list(self._queue)
+            self._queue.clear()
+        return dropped

@@ -58,11 +58,17 @@ def maybe_constrain(x, *spec):
     e.g. ``pipe`` inside the pipeline schedule) — are dropped from the
     spec, so TP/SP constraints compose with any surrounding topology.
     """
-    abstract = jax.sharding.get_abstract_mesh()
+    # the ambient-mesh accessors arrived in newer jax; on versions
+    # without them (no jax.set_mesh either) the library-global mesh
+    # below is the only ambient-mesh channel, so falling through IS the
+    # whole old-jax semantics, not a degraded mode
+    get_abstract_mesh = getattr(jax.sharding, "get_abstract_mesh", None)
+    abstract = None if get_abstract_mesh is None else get_abstract_mesh()
     # the abstract-mesh form of the constraint is only legal under a
     # trace; eagerly (e.g. model.init under jax.set_mesh) fall through
     # to the concrete-mesh NamedSharding path below
-    if not abstract.empty and isinstance(x, jax.core.Tracer):
+    if (abstract is not None and not abstract.empty
+            and isinstance(x, jax.core.Tracer)):
         # inside jax.set_mesh / shard_map: resolve against the ambient
         # abstract mesh, keeping only its Auto (GSPMD-managed) axes
         auto = {n for n, t in zip(abstract.axis_names,
@@ -79,8 +85,9 @@ def maybe_constrain(x, *spec):
     # to the library-global mesh, whose concrete NamedSharding is legal
     # inside jit.
     try:
-        mesh = jax.sharding.get_mesh()
-        if mesh.empty:
+        get_ambient_mesh = getattr(jax.sharding, "get_mesh", None)
+        mesh = None if get_ambient_mesh is None else get_ambient_mesh()
+        if mesh is not None and mesh.empty:
             mesh = None
     except ValueError:
         mesh = None

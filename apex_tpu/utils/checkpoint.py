@@ -27,14 +27,25 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
+def save_checkpoint(path: str, state: Any, *, force: bool = False) -> None:
     """Write ``state`` (any pytree: train state, params, …) to ``path``.
+
+    ``force=False`` (the default) REFUSES to overwrite an existing
+    checkpoint with a ``FileExistsError`` — the old ``force=True``
+    default silently clobbered whatever lived at ``path``, which for a
+    checkpoint API is data loss, not convenience.  Pass ``force=True``
+    to overwrite deliberately (e.g. a rolling "latest" path).
 
     Blocks until the write completes (orbax's async machinery still
     overlaps the device→host copies).
     """
+    path = os.path.abspath(path)
+    if not force and os.path.exists(path):
+        raise FileExistsError(
+            f"checkpoint path {path!r} already exists — refusing to "
+            f"overwrite; pass force=True to clobber it deliberately")
     ckptr = _checkpointer()
-    ckptr.save(os.path.abspath(path), state, force=force)
+    ckptr.save(path, state, force=force)
     ckptr.wait_until_finished()
 
 

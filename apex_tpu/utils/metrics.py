@@ -1,15 +1,26 @@
-"""Lightweight training metrics — dict in, host writer out.
+"""Lightweight metrics — device emissions in, ordered host drain out.
 
 Reference: no metrics subsystem (``print``/``logging`` in examples —
 SURVEY.md §5).  Kept deliberately thin: a device-side metrics dict that
 can be emitted from inside jit via ``jax.debug.callback``, draining to
 any writer (default: the package logger).
+
+Ordering: JAX does not guarantee callback *delivery* order when several
+jitted emissions are in flight (ordered callbacks are unsupported on
+multi-device computations), so every emission is tagged with its
+device-side step and staged; :meth:`MetricsWriter.drain` releases the
+staged rows to the sink in step order, dropping duplicate steps (a
+replayed/donated computation can fire a callback twice).  Call
+``jax.effects_barrier()`` before the final drain to be sure every
+in-flight callback has landed.
 """
 
 from __future__ import annotations
 
+import bisect
 import logging
-from typing import Any, Callable, Dict, Optional
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
@@ -21,27 +32,72 @@ _logger = logging.getLogger("apex_tpu.metrics")
 class MetricsWriter:
     """Collects scalar metrics; pluggable sink (logger, file, list).
 
-    Callback *delivery* order is not guaranteed by JAX when several
-    jitted emissions are in flight (ordered callbacks are unsupported on
-    multi-device computations), so ``history`` is kept sorted by step on
-    insertion; sinks that need strict order should read ``history``
-    after a ``jax.effects_barrier()`` instead of streaming.
+    Emissions (``writer(step, {...})``) are staged, keyed by their
+    device-side step; :meth:`drain` hands them to the sink in ascending
+    step order and appends them to ``history`` (kept globally sorted by
+    step, so a late drain slotting in older steps cannot disorder it).
+    Per step, emissions MERGE key-wise with the first emission winning
+    per key — a jit replay of the identical row is a no-op (the dedupe
+    goal), while a second legitimate emission contributing *different*
+    keys for the step (loss from one callback, grad norms from another)
+    still lands.  An emission for a step that already drained is
+    dropped.  Thread-safe: the server loop, client threads and jax's
+    callback runner may all touch one writer; concurrent drains
+    serialize.
     """
 
-    def __init__(self, sink: Optional[Callable[[int, Dict[str, float]], None]] = None):
-        self.history: list = []
+    def __init__(self, sink: Optional[Callable[[int, Dict[str, float]],
+                                               None]] = None):
+        self.history: List[Tuple[int, Dict[str, float]]] = []
         self._sink = sink
+        self._pending: Dict[int, Dict[str, float]] = {}
+        self._seen: set = set()
+        self._lock = threading.Lock()
+        # serializes whole drains (staging lock alone would let two
+        # drains interleave their history/sink phases out of order);
+        # separate from _lock so a slow sink never blocks emitters
+        self._drain_lock = threading.Lock()
 
     def __call__(self, step: int, metrics: Dict[str, Any]) -> None:
-        import bisect
-
+        step = int(step)
         row = {k: float(v) for k, v in metrics.items()}
-        bisect.insort(self.history, (int(step), row), key=lambda r: r[0])
-        if self._sink is not None:
-            self._sink(int(step), row)
-        else:
-            _logger.info("step %d %s", int(step),
-                         " ".join(f"{k}={v:.6g}" for k, v in row.items()))
+        with self._lock:
+            if step in self._seen:
+                return                      # step already drained
+            staged = self._pending.get(step)
+            if staged is None:
+                self._pending[step] = row
+            else:                           # merge: first wins per key
+                self._pending[step] = {**row, **staged}
+
+    def drain(self) -> List[Tuple[int, Dict[str, float]]]:
+        """Release staged rows in step order; returns them.
+
+        The sink observes rows exactly once, step-ascending within each
+        drain; ``history`` accumulates every drained row, sorted by
+        step even across out-of-order drains.
+        """
+        with self._drain_lock:
+            with self._lock:
+                rows = sorted(self._pending.items())
+                self._pending.clear()
+                self._seen.update(step for step, _ in rows)
+            for step, row in rows:
+                bisect.insort(self.history, (step, row),
+                              key=lambda r: r[0])
+                if self._sink is not None:
+                    self._sink(step, row)
+                else:
+                    _logger.info(
+                        "step %d %s", step,
+                        " ".join(f"{k}={v:.6g}"
+                                 for k, v in row.items()))
+            return rows
+
+    @property
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
 
 
 def log_metrics(writer: MetricsWriter, step, metrics: Dict[str, Any]) -> None:
@@ -50,7 +106,9 @@ def log_metrics(writer: MetricsWriter, step, metrics: Dict[str, Any]) -> None:
     ``jax.debug.callback`` ships the (tiny) scalars to the host without
     blocking the device — the TPU-friendly version of the reference
     examples' per-step prints.  Delivery is unordered (ordered effects
-    don't exist on multi-device computations); ``MetricsWriter.history``
-    is sorted by step on insertion to compensate.
+    don't exist on multi-device computations); the device-side ``step``
+    tags the emission so ``writer.drain()`` restores order on the host.
+    Call ``jax.effects_barrier()`` then ``writer.drain()`` when the
+    rows are needed.
     """
     jax.debug.callback(writer, step, metrics)

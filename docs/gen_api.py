@@ -63,7 +63,10 @@ PAGES = {
     "models": ["apex_tpu.models.bert", "apex_tpu.models.gpt",
                "apex_tpu.models.vit", "apex_tpu.models.resnet",
                "apex_tpu.models.transformer",
+               "apex_tpu.models.generate",
                "apex_tpu.models.torch_import"],
+    "serving": ["apex_tpu.serving.api", "apex_tpu.serving.engine",
+                "apex_tpu.serving.scheduler", "apex_tpu.serving.cache"],
     "utils": ["apex_tpu.utils.checkpoint", "apex_tpu.utils.profiler",
               "apex_tpu.utils.debug", "apex_tpu.utils.metrics",
               "apex_tpu.utils.tree"],

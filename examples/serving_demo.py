@@ -1,0 +1,87 @@
+"""Continuous-batching inference server, end to end on a tiny GPT.
+
+Starts an :class:`apex_tpu.serving.InferenceServer` over a randomly
+initialized tiny GPT, submits a handful of requests with mixed prompt
+lengths, budgets and sampling configs, streams each request's tokens as
+they decode, and prints the server's throughput/occupancy metrics.
+
+The interesting property on display: every request shape/config mix
+runs through ONE compiled decode step (per-slot sampling params are
+device arrays, prompts are bucketed) — the engine's retrace guards
+would raise if anything recompiled mid-traffic.
+
+Run (CPU works):
+    python examples/serving_demo.py [--max-slots 2] [--requests 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-slots", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.serving import InferenceServer
+    from apex_tpu.utils import MetricsWriter
+
+    cfg = GPTConfig.tiny(position_embedding="learned",
+                         scan_layers=True)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    params = {"params": params["params"]}
+
+    rng = np.random.default_rng(args.seed)
+    metrics = MetricsWriter(sink=lambda step, row: print(
+        f"metrics step={step} " + " ".join(
+            f"{k}={v:.3g}" for k, v in sorted(row.items()))))
+
+    # mixed traffic: lengths spanning three buckets, greedy and
+    # sampled tenants side by side in the same compiled step
+    configs = [
+        {"length": 3, "max_new_tokens": 6, "temperature": 0.0},
+        {"length": 7, "max_new_tokens": 4, "temperature": 0.8,
+         "top_k": 20},
+        {"length": 12, "max_new_tokens": 5, "temperature": 1.2,
+         "top_k": 5},
+        {"length": 2, "max_new_tokens": 7, "temperature": 0.0},
+        {"length": 9, "max_new_tokens": 3, "temperature": 0.5},
+    ]
+    configs = [configs[i % len(configs)] for i in range(args.requests)]
+
+    server = InferenceServer(
+        model, params, max_slots=args.max_slots,
+        prompt_buckets=(4, 8, 16), metrics=metrics,
+        metrics_interval=4)
+    with server:
+        handles = []
+        for i, c in enumerate(configs):
+            prompt = rng.integers(0, cfg.vocab_size,
+                                  size=(c["length"],))
+            h = server.submit(
+                prompt,
+                max_new_tokens=c["max_new_tokens"],
+                temperature=c["temperature"],
+                top_k=c.get("top_k"),
+                seed=i)
+            handles.append((i, prompt, h))
+        for i, prompt, h in handles:
+            toks = list(h.stream(timeout=600))
+            print(f"req {i} prompt={prompt.tolist()} -> {toks}")
+    print(f"done: {len(handles)} requests, "
+          f"{server.tokens_emitted} tokens in {server.steps} steps")
+
+
+if __name__ == "__main__":
+    main()

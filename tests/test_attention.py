@@ -211,8 +211,10 @@ class TestForward:
         np.testing.assert_array_equal(np.asarray(want), 0.0)
 
 
+@pytest.mark.slow
 class TestBandEnumeration:
-    """Exhaustive validation of the closed-form banded grid math that
+    """[slow: exhaustive all-(nb, W) enumeration ≈ 40s on CPU]
+    Exhaustive validation of the closed-form banded grid math that
     every causal kernel's BlockSpec index maps and init/final
     predicates run on (W = nb-1 is the full causal triangle)."""
 

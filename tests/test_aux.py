@@ -97,6 +97,24 @@ class TestCheckpoint:
         finally:
             mesh_lib.destroy_mesh()
 
+    def test_save_refuses_overwrite_by_default(self, tmp_path):
+        """Regression: force used to default True, silently clobbering
+        an existing checkpoint."""
+        tree = {"a": jnp.arange(3.0)}
+        path = str(tmp_path / "ckpt")
+        utils.save_checkpoint(path, tree)
+        with pytest.raises(FileExistsError, match="force=True"):
+            utils.save_checkpoint(path, {"a": jnp.zeros(3)})
+        # the refused save must not have touched the original
+        restored = utils.restore_checkpoint(path, tree)
+        np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                      np.arange(3.0))
+        # explicit force overwrites
+        utils.save_checkpoint(path, {"a": jnp.zeros(3)}, force=True)
+        restored = utils.restore_checkpoint(path, tree)
+        np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                      np.zeros(3))
+
     def test_manager_rolls(self, tmp_path):
         import orbax.checkpoint as ocp
         mngr = utils.checkpoint_manager(str(tmp_path / "m"),
@@ -152,8 +170,39 @@ class TestMetrics:
         for i in range(3):
             step(i, jnp.ones((2,))).block_until_ready()
         jax.effects_barrier()
+        w.drain()
         assert [s for s, _ in rows] == [0, 1, 2]
         assert rows[2][1]["loss"] == 4.0
+
+    def test_out_of_order_delivery_ordered_on_drain(self):
+        """JAX guarantees no callback delivery order — emissions tagged
+        with their device-side step must come out of drain() step-
+        ascending, duplicates dropped."""
+        rows = []
+        w = utils.MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+        w(3, {"loss": 3.0})
+        w(1, {"loss": 1.0})
+        w(3, {"loss": 99.0, "extra": 7.0})   # same step: first wins
+        w(2, {"loss": 2.0})                  # per key, new keys merge
+        drained = w.drain()
+        assert [s for s, _ in rows] == [1, 2, 3]
+        assert rows[2][1]["loss"] == 3.0
+        assert rows[2][1]["extra"] == 7.0
+        assert drained == rows
+        # duplicates are dropped across drains too, and a late older
+        # step still lands sorted in history
+        w(3, {"loss": 77.0})
+        w(0, {"loss": 0.0})
+        w.drain()
+        assert [s for s, _ in rows] == [1, 2, 3, 0]
+        assert [s for s, _ in w.history] == [0, 1, 2, 3]
+
+    def test_history_sorted_without_drain_sink(self):
+        w = utils.MetricsWriter(sink=lambda s, m: None)
+        for s in (5, 2, 9, 2):
+            w(s, {"v": float(s)})
+        w.drain()
+        assert [s for s, _ in w.history] == [2, 5, 9]
 
 
 class TestProfiler:

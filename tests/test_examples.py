@@ -35,7 +35,11 @@ _SAMPLE_NPZ = os.path.join(_REPO, "examples", "data",
                            "sample_imagenet.npz")
 
 
+@pytest.mark.slow
 class TestImagenetExample:
+    # [slow: 3 subprocess train runs ≈ 200s — the --data loader branch
+    # integration; the dcgan test below keeps a conv-example subprocess
+    # in tier-1]
     def test_checked_in_shard_trains(self):
         # the in-repo uint8 sample shard (examples/data, regenerable
         # via make_sample.py) through the real --data loader branch
@@ -122,7 +126,22 @@ class TestTransformerTPExample:
         assert "multiple of the microbatch" in (r.stderr + r.stdout)
 
 
+class TestServingDemoExample:
+    def test_mixed_traffic_serves(self):
+        r = _run_example("examples/serving_demo.py",
+                         ["--requests", "5", "--max-slots", "2"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert r.stdout.count("req ") == 5, r.stdout[-2000:]
+        assert "done: 5 requests" in r.stdout, r.stdout[-2000:]
+        # the metrics sink must have streamed at least one ordered row
+        assert "metrics step=" in r.stdout, r.stdout[-2000:]
+
+
+@pytest.mark.slow
 class TestLlamaGenerateExample:
+    # [slow: two subprocess generate runs incl. a torch cross-check
+    # ≈ 85s; greedy parity stays tier-1-covered by test_generate and
+    # test_serving]
     def test_greedy_matches_torch(self):
         r = _run_example("examples/llama_generate.py", [])
         assert r.returncode == 0, r.stderr[-2000:]

@@ -52,7 +52,11 @@ CONFIGS = {
 
 class TestIncrementalDecode:
     @pytest.mark.parametrize("name", sorted(CONFIGS))
-    @pytest.mark.parametrize("scan", [False, True])
+    # the scan_layers=True variants re-compile the whole matrix a
+    # second time (~60s of CPU jit) for a code path whose scan/loop
+    # equivalence test_models covers — slow tier
+    @pytest.mark.parametrize(
+        "scan", [False, pytest.param(True, marks=pytest.mark.slow)])
     def test_matches_full_forward(self, name, scan):
         cfg = CONFIGS[name](scan)
         model = (LlamaModel if name.startswith("llama") else GPTModel)(cfg)
@@ -96,6 +100,7 @@ class TestIncrementalDecode:
         assert att["slot_positions"].shape == (5,)
         assert cfg.max_seq_len > 5
 
+    @pytest.mark.slow
     def test_rolling_cache_short_prefill(self):
         """Regression: prefill SHORTER than window-1 leaves empty ring
         slots; their position encoding (0 = empty) must keep them
@@ -126,6 +131,7 @@ class TestIncrementalDecode:
                 np.asarray(inc), np.asarray(full), atol=2e-5,
                 rtol=2e-5, err_msg=f"prefill={pre}")
 
+    @pytest.mark.slow
     def test_rolling_cache_prefill_longer_than_window(self):
         """A prompt longer than the window wraps the ring during
         prefill; subsequent decode must still match the full forward."""
@@ -152,8 +158,10 @@ class TestIncrementalDecode:
             np.asarray(inc), np.asarray(full), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 class TestMidStreamChunks:
-    """Multi-token decode chunks at arbitrary cache positions (the
+    """[slow: 4 chunk schedules × 3 configs ≈ 1 min of CPU jit]
+    Multi-token decode chunks at arbitrary cache positions (the
     chunked-prefill building block): prefill a few tokens, feed a
     mid-stream chunk, then single-token decode — all logits must match
     the full forward.  Exercises the dense blocked-scan path and the
@@ -268,6 +276,69 @@ class TestGenerate:
         assert with_eos[0, 3] == ref[0, 3], (
             "prompt-contained eos forced the first produced token")
 
+    def test_model_not_pinned_by_memos(self):
+        """Regression: the old ``lru_cache``s were keyed on the module
+        object and pinned up to 64 model instances for the process
+        lifetime; the memos now key on (type, cfg) and hold the model
+        through a weakref, so instances stay collectible."""
+        import gc
+        import weakref
+
+        from apex_tpu.utils import tracecheck
+
+        cfg = GPTConfig.tiny(position_embedding="learned",
+                             scan_layers=True)
+        model = GPTModel(cfg)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)
+        out1 = generate(model, params, prompt, max_new_tokens=2)
+        ref = weakref.ref(model)
+        del model
+        gc.collect()
+        assert ref() is None, (
+            "generate() memoization pinned the model instance")
+        # an equal-config model must revive the cached runner: same
+        # memo entry, no new trace, identical output
+        model2 = GPTModel(cfg)
+        before = tracecheck.trace_event_count()
+        out2 = generate(model2, params, prompt, max_new_tokens=2)
+        assert tracecheck.trace_event_count() == before, (
+            "equal-config model missed the runner memo (retraced)")
+        np.testing.assert_array_equal(np.asarray(out1),
+                                      np.asarray(out2))
+
+    def test_unhashable_model_gets_identity_key(self):
+        """A module with unhashable field values cannot use the value
+        signature; the fallback key must still be hashable (a plain
+        weakref's hash delegates to the unhashable referent) and must
+        die with the instance instead of reviving on id reuse."""
+        import flax.linen as nn
+
+        from apex_tpu.models.generate import (
+            _IdentityKey,
+            _model_signature,
+        )
+
+        class ArrayField(nn.Module):
+            table: np.ndarray      # unhashable field value
+
+            def __call__(self, x):
+                return x
+
+        m = ArrayField(table=np.zeros(3))
+        key = _model_signature(m)
+        assert isinstance(key, _IdentityKey)
+        hash(key)                           # must not raise
+        assert key == _model_signature(m)   # same live instance
+        assert key != _model_signature(ArrayField(table=np.zeros(3)))
+        del m
+        import gc
+
+        gc.collect()
+        # dead ref: the key no longer equals anything (even itself),
+        # so a stale memo entry can never be revived by id reuse
+        assert key != key
+
     def test_sampling_without_rng_raises(self):
         cfg = GPTConfig.tiny(position_embedding="learned")
         model = GPTModel(cfg)
@@ -288,10 +359,14 @@ class TestGenerate:
                          temperature=1.0, top_k=bad,
                          rng=jax.random.PRNGKey(0))
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("name", sorted(CONFIGS))
     def test_chunked_prefill_matches_single_call(self, name):
         """generate() with prefill_chunk must produce the identical
-        token chain as single-call prefill (same cache, same logits)."""
+        token chain as single-call prefill (same cache, same logits).
+        [slow: 3 chunk sizes × 3 configs of fresh jit; the chunked
+        path stays tier-1-covered end to end by test_serving's
+        chunked-prefill engine parity test]"""
         cfg = CONFIGS[name](True)
         model = (LlamaModel if name.startswith("llama") else GPTModel)(cfg)
         prompt = jnp.asarray(np.random.default_rng(5).integers(
@@ -307,8 +382,11 @@ class TestGenerate:
                 err_msg=f"{name} prefill_chunk={chunk}")
 
 
+@pytest.mark.slow
 class TestLongPromptGeneration:
-    """The VERDICT round-4 missing item: a Mistral-style long-prompt
+    """[slow: 32k-token prompts ≈ 70s of CPU compile+run — a chip
+    capability proof, not a unit test]
+    The VERDICT round-4 missing item: a Mistral-style long-prompt
     model must actually generate.  A 32k-token prompt through chunked
     prefill (ring cache + banded flash chunks) — the single-call
     masked-einsum path provably dies at this length (BASELINE.md

@@ -59,7 +59,9 @@ class TestGPT:
         assert outs[True][0] == outs[False][0]
         assert outs[True][1].shape == outs[False][1].shape
 
+    @pytest.mark.slow
     def test_overfits_tiny_batch(self, rng):
+        # [slow: a full mini training loop ≈ 15s of CPU jit+steps]
         cfg = GPTConfig.tiny(num_layers=1, hidden_size=128, num_heads=1,
                              vocab_size=128)
         m = GPTModel(cfg)
@@ -106,7 +108,9 @@ class TestLlama:
     """The Llama recipe (rmsnorm + rope + SwiGLU GQA, no biases) as a
     first-class model family: trains, remats exactly, windows."""
 
+    @pytest.mark.slow
     def test_overfits_tiny_batch_o2(self, rng):
+        # [slow: O2 mini training loop ≈ 10s of CPU jit+steps]
         from apex_tpu.models import LlamaConfig, LlamaModel
         from apex_tpu.optim import fused_adam
 
@@ -245,7 +249,10 @@ class TestTensorParallel:
 
 
 class TestResNet:
+    @pytest.mark.slow
     def test_forward_and_train_step(self, rng):
+        # [slow: resnet18 fwd+train-step compile ≈ 50s on CPU; the
+        # imagenet example (slow tier) and bench legs cover it too]
         from apex_tpu.models import resnet18
         import optax
         m = resnet18(num_classes=10)
@@ -617,8 +624,10 @@ class TestTorchImport:
             amp.deregister_function("fwd_shared")
 
 
+@pytest.mark.slow
 class TestGPT2SliceTP8:
-    """Round-2 verdict item 1's grads assertion: a 2-layer slice of the
+    """[slow: hidden-2048 TP=8 grads on virtual CPU devices ≈ 20s]
+    Round-2 verdict item 1's grads assertion: a 2-layer slice of the
     full GPT-2 1.3B architecture (hidden 2048, 16 heads, SP on), O2
     train-step gradients under TP=8 must match the single-device
     composition bit-for-tolerance.  The full 24-layer model is executed

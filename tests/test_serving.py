@@ -1,0 +1,409 @@
+"""Continuous-batching serving engine (apex_tpu.serving).
+
+Correctness contracts under test:
+- greedy decode through the slotted engine is TOKEN-IDENTICAL to the
+  fixed-batch ``generate()`` loop for the same prompts;
+- a steady-state soak interleaving admissions/evictions across >= 3
+  prompt-length buckets with heterogeneous sampling params triggers
+  ZERO retraces after warmup (asserted both via the process-wide
+  trace-event counter and the engine's own ``retrace_guard`` budgets,
+  which would raise ``RetraceError`` on any excess trace);
+- a request's sampled tokens depend on its own seed, not on its
+  co-tenants (per-slot rng);
+- the threaded ``InferenceServer`` streams tokens, emits metrics, and
+  shuts down cleanly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import GPTConfig, GPTModel, LlamaConfig, LlamaModel, generate
+from apex_tpu.serving import (
+    Engine,
+    InferenceServer,
+    QueueFull,
+    Request,
+    Scheduler,
+)
+from apex_tpu.serving import cache as slot_cache
+from apex_tpu.utils import MetricsWriter, tracecheck
+from apex_tpu.utils.tracecheck import RetraceError
+
+
+def _tiny_gpt():
+    cfg = GPTConfig.tiny(position_embedding="learned",
+                         scan_layers=True)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, {"params": params["params"]}
+
+
+def _tiny_llama():
+    cfg = LlamaConfig.tiny(scan_layers=True)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, {"params": params["params"]}
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    return _tiny_gpt()
+
+
+@pytest.fixture(scope="module")
+def llama():
+    return _tiny_llama()
+
+
+def _prompts(rng, vocab, lengths):
+    return [rng.integers(0, vocab, size=(L,)).astype(np.int32)
+            for L in lengths]
+
+
+class TestSlotCache:
+    def test_pool_shapes_and_reset(self, gpt):
+        model, _ = gpt
+        from apex_tpu.models.generate import cache_shapes
+
+        shapes = cache_shapes(model, 1)
+        pool = slot_cache.stacked_zeros(shapes, 3)
+        flat = jax.tree.leaves(pool)
+        per_slot = jax.tree.leaves(shapes)
+        assert all(p.shape == (3,) + tuple(s.shape)
+                   for p, s in zip(flat, per_slot))
+        # write then reset roundtrips to zeros
+        one = jax.tree.map(
+            lambda s: jnp.ones(s.shape, s.dtype), shapes)
+        pool = slot_cache.write_slot(pool, 1, one)
+        assert all(float(jnp.sum(jnp.abs(leaf[1].astype(jnp.float32))))
+                   > 0 for leaf in jax.tree.leaves(pool))
+        pool = slot_cache.reset_slot(pool, 1)
+        assert all(float(jnp.sum(jnp.abs(leaf.astype(jnp.float32))))
+                   == 0 for leaf in jax.tree.leaves(pool))
+
+    def test_rewind_targets_only_index_leaves(self, gpt):
+        model, _ = gpt
+        from apex_tpu.models.generate import init_cache
+
+        cache = init_cache(model, 1)
+        cache = jax.tree.map(
+            lambda x: x + jnp.ones_like(x), cache)
+        out = slot_cache.rewind_index_leaves(cache, 7)
+        flat = jax.tree_util.tree_flatten_with_path(out)[0]
+        saw_index = 0
+        for path, leaf in flat:
+            name = slot_cache._leaf_name(path)
+            if name in ("cache_index", "position_index"):
+                saw_index += 1
+                assert np.all(np.asarray(leaf) == 7), name
+            else:
+                assert np.all(np.asarray(leaf) == 1), name
+        assert saw_index >= 2       # per-layer cache_index + model pos
+
+    def test_sliding_window_cache_rejected(self):
+        cfg = LlamaConfig.tiny(sliding_window=5, scan_layers=False)
+        model = LlamaModel(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 4), jnp.int32))
+        with pytest.raises(ValueError, match="ring-buffer"):
+            Engine(model, {"params": params["params"]},
+                   max_slots=2, prompt_buckets=(8,))
+
+
+class TestEngineValidation:
+    def test_bucket_exceeding_max_seq_len_rejected(self, gpt):
+        model, params = gpt
+        S = model.cfg.max_seq_len
+        with pytest.raises(ValueError, match="bucket"):
+            Engine(model, params, prompt_buckets=(S,))
+
+    def test_oversized_request_rejected_at_submit(self, gpt):
+        model, params = gpt
+        engine = Engine(model, params, max_slots=1,
+                        prompt_buckets=(8,))
+        sched = Scheduler(engine)
+        with pytest.raises(ValueError, match="bucket"):
+            sched.submit(Request(prompt=np.zeros(9, np.int32),
+                                 max_new_tokens=1))
+        with pytest.raises(ValueError, match="max_seq_len"):
+            sched.submit(Request(
+                prompt=np.zeros(8, np.int32),
+                max_new_tokens=model.cfg.max_seq_len))
+        with pytest.raises(ValueError, match="top_k"):
+            sched.submit(Request(prompt=np.zeros(4, np.int32),
+                                 max_new_tokens=2,
+                                 temperature=1.0,
+                                 top_k=model.cfg.vocab_size + 1))
+
+    def test_queue_capacity_bounded(self, gpt):
+        model, params = gpt
+        engine = Engine(model, params, max_slots=1,
+                        prompt_buckets=(8,))
+        sched = Scheduler(engine, queue_capacity=2)
+        for _ in range(2):
+            sched.submit(Request(prompt=np.zeros(4, np.int32),
+                                 max_new_tokens=1))
+        with pytest.raises(QueueFull):
+            sched.submit(Request(prompt=np.zeros(4, np.int32),
+                                 max_new_tokens=1))
+
+
+class TestGreedyParity:
+    @pytest.mark.l0
+    @pytest.mark.parametrize("which", ["gpt", "llama"])
+    def test_engine_matches_generate(self, which, request):
+        """Mixed-length greedy requests through 2 slots must reproduce
+        generate()'s token chains exactly — including requests that
+        queue behind the first wave (continuous refill)."""
+        model, params = request.getfixturevalue(which)
+        rng = np.random.default_rng(3)
+        prompts = _prompts(rng, model.cfg.vocab_size,
+                           (3, 5, 8, 4, 11))
+        budgets = [6, 3, 5, 7, 4]
+        engine = Engine(model, params, max_slots=2,
+                        prompt_buckets=(4, 8, 16))
+        sched = Scheduler(engine)
+        reqs = [sched.submit(Request(prompt=p, max_new_tokens=n))
+                for p, n in zip(prompts, budgets)]
+        sched.drain()
+        for p, n, r in zip(prompts, budgets, reqs):
+            ref = np.asarray(generate(
+                model, params, jnp.asarray(p[None]),
+                max_new_tokens=n))[0, len(p):]
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens), ref,
+                err_msg=f"{which} prompt_len={len(p)} n={n}")
+
+    def test_chunked_prefill_engine_matches_generate(self, gpt):
+        """The engine's prefill rides the same chunked path as
+        generate(prefill_chunk=...): forcing small chunks must not
+        change the greedy token chain."""
+        model, params = gpt
+        rng = np.random.default_rng(19)
+        prompt = rng.integers(0, model.cfg.vocab_size,
+                              size=(11,)).astype(np.int32)
+        ref = np.asarray(generate(
+            model, params, jnp.asarray(prompt[None]),
+            max_new_tokens=4))[0, 11:]
+        engine = Engine(model, params, max_slots=1,
+                        prompt_buckets=(16,), prefill_chunk=4)
+        sched = Scheduler(engine)
+        req = sched.submit(Request(prompt=prompt, max_new_tokens=4))
+        sched.drain()
+        np.testing.assert_array_equal(np.asarray(req.tokens), ref)
+
+    def test_eos_stops_early_and_matches_generate(self, gpt):
+        model, params = gpt
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, model.cfg.vocab_size,
+                              size=(5,)).astype(np.int32)
+        n = 8
+        ref = np.asarray(generate(
+            model, params, jnp.asarray(prompt[None]),
+            max_new_tokens=n))[0, 5:]
+        eos = int(ref[2])            # force a stop three tokens in
+        engine = Engine(model, params, max_slots=1,
+                        prompt_buckets=(8,))
+        sched = Scheduler(engine)
+        req = sched.submit(Request(prompt=prompt, max_new_tokens=n,
+                                   eos_id=eos))
+        sched.drain()
+        got = np.asarray(req.tokens)
+        # engine stops AT the produced eos; generate's chain up to the
+        # first eos must match token for token
+        first = int(np.argmax(ref == eos))
+        np.testing.assert_array_equal(got, ref[:first + 1])
+        assert got[-1] == eos and len(got) < n
+
+
+class TestSamplingDeterminism:
+    def test_tokens_independent_of_cotenants(self, gpt):
+        """A sampled request carries its own rng (seeded at admission):
+        running alone or beside other traffic must not change its
+        tokens."""
+        model, params = gpt
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, model.cfg.vocab_size,
+                              size=(6,)).astype(np.int32)
+
+        def run(extra_traffic):
+            engine = Engine(model, params, max_slots=2,
+                            prompt_buckets=(8,))
+            sched = Scheduler(engine)
+            req = sched.submit(Request(
+                prompt=prompt, max_new_tokens=5, temperature=0.9,
+                top_k=20, seed=123))
+            if extra_traffic:
+                for i in range(3):
+                    sched.submit(Request(
+                        prompt=rng.integers(
+                            0, model.cfg.vocab_size,
+                            size=(4 + i,)).astype(np.int32),
+                        max_new_tokens=4, temperature=1.3, seed=i))
+            sched.drain()
+            return list(req.tokens)
+
+        assert run(False) == run(True)
+
+
+class TestSoakZeroRetraces:
+    def test_steady_state_soak(self, gpt):
+        """The acceptance soak: >= 3 prompt-length buckets, mixed
+        temperatures / top_k / eos / budgets, admissions and evictions
+        interleaving across 14 requests through 3 slots — zero jaxpr
+        traces after warmup.  The engine's retrace_guards (budget:
+        decode_step/admit/release = 1, prefill = #buckets) raise
+        RetraceError on any excess trace, and the process-wide
+        trace-event counter cross-checks the whole soak."""
+        model, params = gpt
+        engine = Engine(model, params, max_slots=3,
+                        prompt_buckets=(4, 8, 16))
+        sched = Scheduler(engine)
+        engine.warmup()
+        assert engine.trace_counts == {
+            "decode_step": 1, "prefill": 3, "admit": 1, "release": 1}
+
+        rng = np.random.default_rng(11)
+        before = tracecheck.trace_event_count()
+        cases = [
+            (3, 4, 0.0, None, None), (7, 3, 0.8, 20, None),
+            (12, 5, 1.2, 5, None), (2, 6, 0.0, None, 17),
+            (8, 2, 0.5, None, None), (16, 4, 0.0, None, None),
+            (5, 3, 1.0, 50, 3), (4, 5, 0.0, None, None),
+            (9, 4, 0.7, 10, None), (1, 2, 0.0, None, None),
+            (13, 3, 1.5, 2, None), (6, 6, 0.0, None, 900),
+            (11, 2, 0.9, None, None), (8, 4, 0.0, None, None),
+        ]
+        reqs = []
+        for i, (L, n, t, k, eos) in enumerate(cases):
+            reqs.append(sched.submit(Request(
+                prompt=rng.integers(0, model.cfg.vocab_size,
+                                    size=(L,)).astype(np.int32),
+                max_new_tokens=n, temperature=t, top_k=k,
+                eos_id=eos, seed=i)))
+        events = sched.drain()
+        assert tracecheck.trace_event_count() == before, (
+            "steady-state soak retraced after warmup")
+        assert engine.trace_counts == {
+            "decode_step": 1, "prefill": 3, "admit": 1, "release": 1}
+        # every request produced tokens and respected its budget
+        for (L, n, t, k, eos), r in zip(cases, reqs):
+            assert 1 <= len(r.tokens) <= n
+            if eos is None:
+                assert len(r.tokens) == n
+        assert len(events) == sum(len(r.tokens) for r in reqs)
+
+    def test_unbucketable_prompt_raises_not_retraces(self, gpt):
+        model, params = gpt
+        engine = Engine(model, params, max_slots=1,
+                        prompt_buckets=(4,))
+        with pytest.raises(ValueError, match="bucket"):
+            engine.admit(0, np.zeros(5, np.int32), max_new_tokens=1)
+
+    def test_guard_raises_on_forced_retrace(self, gpt):
+        """The guard is live, not decorative: bypassing the bucketer
+        with a second prefill shape beyond the budget must raise
+        RetraceError (this is what a shape leak in production would
+        look like)."""
+        model, params = gpt
+        engine = Engine(model, params, max_slots=1,
+                        prompt_buckets=(4,))
+        engine.warmup()
+        with pytest.raises(RetraceError):
+            engine._prefill(engine._variables,
+                            jnp.zeros((1, 6), jnp.int32), np.int32(6))
+
+
+class TestInferenceServer:
+    def test_streaming_and_metrics(self, gpt):
+        model, params = gpt
+        rows = []
+        writer = MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+        server = InferenceServer(
+            model, params, max_slots=2, prompt_buckets=(4, 8),
+            metrics=writer, metrics_interval=2)
+        rng = np.random.default_rng(13)
+        with server:
+            h1 = server.submit(
+                rng.integers(0, model.cfg.vocab_size, size=(3,)),
+                max_new_tokens=4)
+            h2 = server.submit(
+                rng.integers(0, model.cfg.vocab_size, size=(6,)),
+                max_new_tokens=3, temperature=0.8, seed=4)
+            streamed = list(h1.stream(timeout=300))
+            assert streamed == h1.result(timeout=300)
+            assert len(streamed) == 4
+            assert len(h2.result(timeout=300)) == 3
+        assert rows, "metrics never emitted"
+        steps = [s for s, _ in rows]
+        assert steps == sorted(steps)
+        for _, m in rows:
+            assert {"tokens_per_sec", "occupancy",
+                    "queue_depth"} <= set(m)
+            assert 0.0 <= m["occupancy"] <= 1.0
+
+    def test_greedy_parity_through_server(self, gpt):
+        model, params = gpt
+        rng = np.random.default_rng(17)
+        prompt = rng.integers(0, model.cfg.vocab_size,
+                              size=(5,)).astype(np.int32)
+        ref = np.asarray(generate(
+            model, params, jnp.asarray(prompt[None]),
+            max_new_tokens=5))[0, 5:]
+        with InferenceServer(model, params, max_slots=2,
+                             prompt_buckets=(8,)) as server:
+            got = server.submit(
+                prompt, max_new_tokens=5).result(timeout=300)
+        np.testing.assert_array_equal(np.asarray(got), ref)
+
+    def test_shutdown_without_drain_cancels(self, gpt):
+        from apex_tpu.serving import ServerClosed
+
+        model, params = gpt
+        server = InferenceServer(model, params, max_slots=1,
+                                 prompt_buckets=(4,))
+        server.start(warmup=False)
+        h = server.submit(np.zeros(3, np.int32), max_new_tokens=200)
+        server.shutdown(wait=False, timeout=60)
+        with pytest.raises((ServerClosed, TimeoutError)):
+            h.result(timeout=60)
+
+    def test_worker_crash_cancels_clients(self, gpt):
+        """An engine failure inside the serving loop must not strand
+        clients: handles raise ServerClosed, submit refuses, and the
+        root cause is preserved on server.error."""
+        from apex_tpu.serving import ServerClosed
+
+        model, params = gpt
+        server = InferenceServer(model, params, max_slots=1,
+                                 prompt_buckets=(4,))
+        boom = RuntimeError("engine exploded")
+
+        def exploding_step():
+            raise boom
+
+        server.scheduler.run_step = exploding_step
+        server.start(warmup=False)
+        h = server.submit(np.zeros(3, np.int32), max_new_tokens=4)
+        with pytest.raises(ServerClosed):
+            h.result(timeout=60)
+        with pytest.raises(ServerClosed):
+            server.submit(np.zeros(2, np.int32), max_new_tokens=1)
+        assert server.error is boom
+        server.shutdown(timeout=60)
+
+    def test_submit_after_shutdown_raises(self, gpt):
+        from apex_tpu.serving import ServerClosed
+
+        model, params = gpt
+        server = InferenceServer(model, params, max_slots=1,
+                                 prompt_buckets=(4,))
+        server.start(warmup=False)
+        server.shutdown()
+        with pytest.raises(ServerClosed):
+            server.submit(np.zeros(2, np.int32), max_new_tokens=1)
