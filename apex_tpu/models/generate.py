@@ -22,12 +22,13 @@ decode loop over :class:`~apex_tpu.models.gpt.GPTModel` /
 Static-shape discipline: prompts share one length (pad-free; ragged
 batches should be bucketed by the caller) and ``max_new_tokens`` is
 static.  The compiled loop is cached per ``(model, max_new_tokens,
-temperature, top_k, eos_id, prefill_chunk)`` signature (jit handles
-the shape axis), so repeated same-shape calls do not retrace.
+temperature, top_k, top_p, eos_id, prefill_chunk)`` signature (jit
+handles the shape axis), so repeated same-shape calls do not retrace.
 
 The building blocks — :func:`apply_decode` (one cached-decode model
 application), :func:`prefill_tokens` (single-call or chunked prefill)
-and :func:`sample_logits` (greedy / temperature / top-k) — are public:
+and :func:`sample_logits` (greedy / temperature / top-k / nucleus
+top-p) — are public:
 ``apex_tpu.serving`` composes them into the continuous-batching engine,
 so the two inference surfaces share one prefill and one sampling
 definition.
@@ -214,24 +215,49 @@ def prefill_tokens(model, variables, cache, prompt_ids,
 
 
 def sample_logits(logits, key, *, temperature: float,
-                  top_k: Optional[int] = None):
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
     """Sample next tokens from last-position ``logits`` (b, vocab).
 
-    ``temperature`` / ``top_k`` are PYTHON statics (part of the jit
-    signature): ``temperature <= 0`` is pure fp32 argmax (no rng use),
-    otherwise logits/temperature are sampled, optionally truncated to
-    the ``top_k`` highest-scoring tokens.  The serving engine's
-    per-slot *array*-parameter variant of the same math lives in
-    ``apex_tpu.serving.engine`` (device-carried params, one executable
-    for mixed configs).
+    ``temperature`` / ``top_k`` / ``top_p`` are PYTHON statics (part
+    of the jit signature): ``temperature <= 0`` is pure fp32 argmax
+    (no rng use), otherwise logits/temperature are sampled, optionally
+    truncated to the ``top_k`` highest-scoring tokens and/or the
+    nucleus — the smallest set of tokens whose probability mass
+    reaches ``top_p`` (Holtzman et al.; the HF default sampler).
+    Filter order matches HF: top-k first, then top-p over the
+    truncated distribution; ``top_p=1.0`` (or None) disables the
+    nucleus filter exactly.  The serving engine's per-slot
+    *array*-parameter variant of the same math lives in
+    ``apex_tpu.serving.engine`` (device-carried params, one
+    executable for mixed configs).
     """
     logits = logits.astype(jnp.float32)
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / temperature
+    asc = None
     if top_k is not None:
-        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        asc = jnp.sort(scaled, axis=-1)                  # ascending
+        kth = asc[:, -top_k][:, None]
         scaled = jnp.where(scaled < kth, -1e30, scaled)
+    if top_p is not None and top_p < 1.0:
+        if asc is None:
+            desc = jnp.sort(scaled, axis=-1)[:, ::-1]    # descending
+        else:
+            # reuse the top-k sort: apply the SAME `< kth` criterion
+            # that masked `scaled` (value-based, so k-th-boundary
+            # ties land identically) instead of re-sorting the vocab
+            rev = asc[:, ::-1]
+            desc = jnp.where(rev < kth, -1e30, rev)
+        probs = jax.nn.softmax(desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep a token iff the mass BEFORE it is < top_p (the argmax
+        # token is always kept); threshold = smallest kept logit
+        keep = cum - probs < top_p
+        thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                         keepdims=True)
+        scaled = jnp.where(scaled < thresh, -1e30, scaled)
     return jax.random.categorical(key, scaled).astype(jnp.int32)
 
 
@@ -267,14 +293,15 @@ _run_memo: dict = {}
 
 def _compiled_run(model, max_new_tokens: int, temperature: float,
                   top_k: Optional[int], eos_id: Optional[int],
-                  prefill_chunk: int = 0) -> _Runner:
+                  prefill_chunk: int = 0,
+                  top_p: Optional[float] = None) -> _Runner:
     """One jitted prefill+scan loop per static signature.
 
     Keyed on the model's value signature (see :func:`_model_signature`);
     jit's own cache handles the (batch, prompt_len) shape axis on top.
     """
     key = (_model_signature(model), max_new_tokens, temperature,
-           top_k, eos_id, prefill_chunk)
+           top_k, eos_id, prefill_chunk, top_p)
     runner = _run_memo.get(key)
     if runner is not None:
         runner.bind(model)
@@ -298,7 +325,7 @@ def _compiled_run(model, max_new_tokens: int, temperature: float,
                                      prompt_ids, prefill_chunk)
         rng, key = jax.random.split(rng)
         tok = sample_logits(last, key, temperature=temperature,
-                            top_k=top_k)
+                            top_k=top_k, top_p=top_p)
         # eos latches only on PRODUCED tokens — a prompt-contained
         # eos_id (bos/document-separator usage) must not kill the batch
         done0 = jnp.zeros((b,), bool)
@@ -309,7 +336,8 @@ def _compiled_run(model, max_new_tokens: int, temperature: float,
                                          tok[:, None])
             rng, key = jax.random.split(rng)
             nxt = sample_logits(logits[:, -1], key,
-                                temperature=temperature, top_k=top_k)
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p)
             if eos_id is not None:
                 done = done | (tok == eos_id)
                 nxt = jnp.where(done, eos_id, nxt)
@@ -330,6 +358,7 @@ def _compiled_run(model, max_new_tokens: int, temperature: float,
 
 def generate(model, params, prompt_ids, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              rng=None, eos_id: Optional[int] = None,
              prefill_chunk: Optional[int] = None):
     """Generate ``max_new_tokens`` continuations of ``prompt_ids``.
@@ -337,9 +366,11 @@ def generate(model, params, prompt_ids, *, max_new_tokens: int,
     ``prompt_ids``: ``(batch, prompt_len)`` int32 (one shared length —
     bucket ragged prompts before calling).  ``temperature=0`` is greedy
     argmax; otherwise logits/temperature are sampled (optionally top-k
-    truncated).  After ``eos_id`` is *produced* a sequence keeps
-    emitting ``eos_id`` (static shapes — no early exit under jit);
-    eos tokens already in the prompt are ignored.
+    and/or nucleus (``top_p``) truncated — ``top_p=1.0`` is exactly
+    plain sampling, the HF-default convention).  After ``eos_id`` is
+    *produced* a sequence keeps emitting ``eos_id`` (static shapes —
+    no early exit under jit); eos tokens already in the prompt are
+    ignored.
 
     ``prefill_chunk``: process the prompt in fixed-size chunks of this
     many tokens (bounds prefill score temps to O(chunk·window) /
@@ -367,6 +398,8 @@ def generate(model, params, prompt_ids, *, max_new_tokens: int,
         raise ValueError(
             f"top_k must be in [1, vocab_size={model.cfg.vocab_size}], "
             f"got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if prefill_chunk is None:
         prefill_chunk = 2048 if prompt_len > 8192 else 0
     elif prefill_chunk < 0:
@@ -378,7 +411,8 @@ def generate(model, params, prompt_ids, *, max_new_tokens: int,
         model, int(max_new_tokens), float(temperature),
         None if top_k is None else int(top_k),
         None if eos_id is None else int(eos_id),
-        int(prefill_chunk))
+        int(prefill_chunk),
+        None if top_p is None else float(top_p))
     # the final cache rides along purely as the donation alias target
     ids, _final_cache = runner.run(dict(params), cache, prompt_ids, rng)
     return ids

@@ -6,10 +6,24 @@ ResNet-50 under amp O1/O2 with apex DDP / SyncBatchNorm
 
 TPU design: channels-last convs (native TPU layout), BN as
 :class:`apex_tpu.parallel.SyncBatchNorm` (cross-replica Welford via
-``psum`` when a data axis is bound, plain BN otherwise), the
-conv+BN+ReLU chains and residual epilogues fused by XLA into the conv
-calls — the same fusions ``apex/contrib/bottleneck`` hand-builds with
-cudnn-frontend graphs.
+``psum`` when a data axis is bound, plain BN otherwise).  Two
+HBM-traffic levers close the round-5 calibration gap (the XLA program
+moved ≈2.2× the architecture-mandated bytes — BASELINE.md "Round-5
+ResNet roofline calibration"):
+
+- ``ResNetConfig.fused_bn=True`` routes every BN through the fused
+  Pallas(+custom-vjp) kernels of :mod:`apex_tpu.ops.batch_norm` — the
+  normalize, residual-add and ReLU collapse into one pass, and the
+  backward computes both statistics plus dγ/dβ in a single read (the
+  same fusions ``apex/contrib/groupbn`` + ``apex/contrib/bottleneck``
+  hand-build with cudnn-frontend graphs).
+- ``ResNetConfig.stem="s2d"`` is the MLPerf-style space-to-depth
+  rework of the 7×7/stride-2 conv0: the input is reshaped
+  ``(N,224,224,3) → (N,112,112,12)`` and the conv becomes a 4×4
+  stride-1 conv over the depth-stacked pixels — mathematically
+  identical (see :func:`stem_conv_to_s2d`), but without the badly
+  tiled 3-channel patch materialization (C=3 pads to the 128-lane
+  tile; C=12 packs 4× denser, and the stride-2 gather disappears).
 """
 
 from __future__ import annotations
@@ -19,11 +33,13 @@ from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import flax.linen as nn
 
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
 
-__all__ = ["ResNetConfig", "ResNet", "resnet50", "resnet18"]
+__all__ = ["ResNetConfig", "ResNet", "resnet50", "resnet18",
+           "space_to_depth", "stem_conv_to_s2d", "convert_stem_to_s2d"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,19 +51,98 @@ class ResNetConfig:
     bn_axis_names: Optional[Sequence[str]] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    #: route every BN through the fused kernels (ops/batch_norm.py):
+    #: stats+normalize+add+ReLU in single passes, fused backward
+    fused_bn: bool = False
+    #: "conv" = the classic 7×7/stride-2 conv0; "s2d" = the MLPerf
+    #: space-to-depth stem (4×4/stride-1 over (N,112,112,12) input)
+    stem: str = "conv"
+
+
+# --------------------------------------------------------------------- #
+# space-to-depth stem helpers
+# --------------------------------------------------------------------- #
+def space_to_depth(x, block: int = 2):
+    """NHWC space-to-depth: ``(N, H, W, C) → (N, H/b, W/b, b·b·C)``.
+
+    Depth order is ``(row_offset, col_offset, channel)`` — the layout
+    :func:`stem_conv_to_s2d` assumes.
+    """
+    n, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(
+            f"spatial dims {(h, w)} not divisible by block {block}")
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
+def stem_conv_to_s2d(w7) -> jnp.ndarray:
+    """Transform a ``(7, 7, C, O)`` stride-2 stem kernel into the
+    equivalent ``(4, 4, 4·C, O)`` stride-1 kernel over space-to-depth
+    input.
+
+    Derivation: zero-pad the kernel to 8×8 (one leading zero row/col),
+    then fold each 2×2 tap offset into the depth axis — with the conv
+    padded ``(2, 1)`` per spatial dim, the composition reproduces the
+    original 7×7/stride-2 conv (padding 3) output exactly; the parity
+    test asserts logits equality.  Run once at init / checkpoint
+    import — never per step.
+    """
+    w7 = jnp.asarray(w7)
+    if w7.shape[:2] != (7, 7):
+        raise ValueError(f"expected a (7, 7, C, O) kernel, got "
+                         f"{w7.shape}")
+    _, _, c, o = w7.shape
+    w8 = jnp.zeros((8, 8, c, o), w7.dtype).at[1:, 1:].set(w7)
+    # [2M+a, 2N+b, c, o] -> [M, N, (a, b, c), o]
+    v = w8.reshape(4, 2, 4, 2, c, o).transpose(0, 2, 1, 3, 4, 5)
+    return v.reshape(4, 4, 4 * c, o)
+
+
+def convert_stem_to_s2d(variables: dict) -> dict:
+    """Convert a plain-stem ResNet ``variables`` tree (or its
+    ``params`` subtree) to the ``stem="s2d"`` layout by transforming
+    the stem kernel in place (pure function — returns a new tree)."""
+    wrapped = "params" in variables
+    tree = dict(variables["params"] if wrapped else variables)
+    stem = dict(tree["stem"])
+    stem["kernel"] = stem_conv_to_s2d(stem["kernel"])
+    tree["stem"] = stem
+    if wrapped:
+        out = dict(variables)
+        out["params"] = tree
+        return out
+    return tree
 
 
 class _BN(nn.Module):
+    """BN with the block's epilogue (optional residual-add + ReLU)
+    folded in when ``cfg.fused_bn``; identical math (and identical
+    parameter tree — the inner SyncBatchNorm module) either way."""
+
     cfg: ResNetConfig
     train: bool
+    act: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x):
-        return SyncBatchNorm(
+    def __call__(self, x, residual=None):
+        cfg = self.cfg
+        bn = SyncBatchNorm(
             use_running_average=not self.train,
-            axis_names=self.cfg.bn_axis_names,
-            param_dtype=self.cfg.param_dtype,
-        )(x)
+            axis_names=cfg.bn_axis_names,
+            param_dtype=cfg.param_dtype,
+            fused=cfg.fused_bn,
+            act=self.act if cfg.fused_bn else None,
+        )
+        if cfg.fused_bn:
+            return bn(x, residual=residual)
+        y = bn(x)
+        if residual is not None:
+            y = y + residual
+        if self.act == "relu":
+            y = nn.relu(y)
+        return y
 
 
 class _BottleneckBlock(nn.Module):
@@ -64,15 +159,16 @@ class _BottleneckBlock(nn.Module):
             use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name=name)
         r = conv(self.features, 1, 1, "conv1")(x)
-        r = nn.relu(_BN(cfg, self.train, name="bn1")(r))
+        r = _BN(cfg, self.train, act="relu", name="bn1")(r)
         r = conv(self.features, 3, self.stride, "conv2")(r)
-        r = nn.relu(_BN(cfg, self.train, name="bn2")(r))
+        r = _BN(cfg, self.train, act="relu", name="bn2")(r)
         r = conv(self.features * 4, 1, 1, "conv3")(r)
-        r = _BN(cfg, self.train, name="bn3")(r)
         if self.stride != 1 or x.shape[-1] != self.features * 4:
             x = conv(self.features * 4, 1, self.stride, "downsample")(x)
             x = _BN(cfg, self.train, name="bn_down")(x)
-        return nn.relu(r + x)
+        # bn3 + residual-add + ReLU: one fused pass under fused_bn
+        return _BN(cfg, self.train, act="relu", name="bn3")(
+            r, residual=x)
 
 
 class ResNet(nn.Module):
@@ -83,10 +179,24 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, *, train: bool = True):
         cfg = self.cfg
-        x = nn.Conv(cfg.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, dtype=cfg.dtype,
-                    param_dtype=cfg.param_dtype, name="stem")(x)
-        x = nn.relu(_BN(cfg, train, name="bn_stem")(x))
+        if cfg.stem == "s2d":
+            # MLPerf space-to-depth stem: same function as the
+            # 7×7/stride-2 conv (stem_conv_to_s2d maps the weights),
+            # minus the 3-channel strided patch materialization
+            x = space_to_depth(x)
+            x = nn.Conv(cfg.width, (4, 4), (1, 1),
+                        padding=[(2, 1), (2, 1)], use_bias=False,
+                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        name="stem")(x)
+        elif cfg.stem == "conv":
+            x = nn.Conv(cfg.width, (7, 7), (2, 2),
+                        padding=[(3, 3), (3, 3)], use_bias=False,
+                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        name="stem")(x)
+        else:
+            raise ValueError(
+                f"unknown stem {cfg.stem!r} (want 'conv' or 's2d')")
+        x = _BN(cfg, train, act="relu", name="bn_stem")(x)
         x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
         for i, n_blocks in enumerate(cfg.stage_sizes):
             for j in range(n_blocks):

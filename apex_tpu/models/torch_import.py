@@ -36,7 +36,7 @@ from typing import Any, Mapping
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["load_torch_gpt2", "load_torch_llama"]
+__all__ = ["load_torch_gpt2", "load_torch_llama", "load_torch_resnet"]
 
 
 def _to_np(x) -> np.ndarray:
@@ -230,6 +230,105 @@ def _write_layers(trans, n_ckpt: int, values_of):
             put_into(sub, path,
                      np.stack([per_layer[i][path]
                                for i in range(n_layers)]))
+
+
+# --------------------------------------------------------------------- #
+# ResNet (torchvision bottleneck family) import
+# --------------------------------------------------------------------- #
+def load_torch_resnet(variables: Any, state_dict: Mapping[str, Any], *,
+                      stem: str = "conv") -> Any:
+    """Map a torchvision bottleneck-ResNet state dict onto
+    :class:`apex_tpu.models.resnet.ResNet` variables.
+
+    ``variables``: the full ``init`` tree (``{"params": ...,
+    "batch_stats": ...}``) of a model whose ``stage_sizes`` match the
+    checkpoint's ``layer{1..K}`` block counts.  Conv weights transpose
+    from torch's ``(O, I, kh, kw)`` to the flax ``(kh, kw, I, O)``
+    kernel; BN ``weight``/``bias`` land on ``scale``/``bias`` and
+    ``running_mean``/``running_var`` on the ``batch_stats`` leaves
+    (torch stores the Bessel-corrected variance, exactly what
+    ``SyncBatchNorm`` tracks).  ``fc`` transposes like any
+    ``nn.Linear``.
+
+    ``stem="s2d"``: the checkpoint's 7×7/stride-2 ``conv1`` weight is
+    run through :func:`apex_tpu.models.resnet.stem_conv_to_s2d` so a
+    standard torchvision checkpoint loads into the space-to-depth stem
+    (``ResNetConfig.stem="s2d"``) with identical logits — checkpoint
+    compatibility is layout-independent.
+    """
+    from apex_tpu.models.resnet import stem_conv_to_s2d
+
+    if stem not in ("conv", "s2d"):
+        raise ValueError(f"unknown stem {stem!r} (want 'conv' or 's2d')")
+    if "params" not in variables or "batch_stats" not in variables:
+        raise ValueError(
+            "load_torch_resnet needs the full variables tree "
+            "({'params', 'batch_stats'}) — BN running stats are part "
+            "of the checkpoint")
+    import copy
+
+    params = copy.deepcopy(dict(variables["params"]))
+    stats = copy.deepcopy(dict(variables["batch_stats"]))
+
+    def conv_w(key):
+        if key not in state_dict:
+            raise KeyError(
+                f"torch state dict is missing '{key}' (have e.g. "
+                f"{sorted(state_dict)[:4]}...)")
+        return _to_np(state_dict[key]).transpose(2, 3, 1, 0)
+
+    def put_bn(pt_prefix, p_node, s_node):
+        # _BN wraps SyncBatchNorm as its (only) anonymous child
+        p_bn = p_node["SyncBatchNorm_0"]
+        s_bn = s_node["SyncBatchNorm_0"]
+        p_bn["scale"] = _set_leaf(
+            p_bn["scale"], _to_np(state_dict[pt_prefix + ".weight"]))
+        p_bn["bias"] = _set_leaf(
+            p_bn["bias"], _to_np(state_dict[pt_prefix + ".bias"]))
+        s_bn["mean"] = _set_leaf(
+            s_bn["mean"], _to_np(state_dict[pt_prefix + ".running_mean"]))
+        s_bn["var"] = _set_leaf(
+            s_bn["var"], _to_np(state_dict[pt_prefix + ".running_var"]))
+
+    w1 = conv_w("conv1.weight")
+    if stem == "s2d":
+        w1 = np.asarray(stem_conv_to_s2d(w1))
+    params["stem"]["kernel"] = _set_leaf(params["stem"]["kernel"], w1)
+    put_bn("bn1", params["bn_stem"], stats["bn_stem"])
+
+    n_stages = sum(1 for k in params if k.startswith("stage")
+                   and k.endswith("block0"))
+    for i in range(n_stages):
+        j = 0
+        while f"stage{i}_block{j}" in params:
+            blk = f"stage{i}_block{j}"
+            pt = f"layer{i + 1}.{j}"
+            for k in (1, 2, 3):
+                params[blk][f"conv{k}"]["kernel"] = _set_leaf(
+                    params[blk][f"conv{k}"]["kernel"],
+                    conv_w(f"{pt}.conv{k}.weight"))
+                put_bn(f"{pt}.bn{k}", params[blk][f"bn{k}"],
+                       stats[blk][f"bn{k}"])
+            if "downsample" in params[blk]:
+                params[blk]["downsample"]["kernel"] = _set_leaf(
+                    params[blk]["downsample"]["kernel"],
+                    conv_w(f"{pt}.downsample.0.weight"))
+                put_bn(f"{pt}.downsample.1", params[blk]["bn_down"],
+                       stats[blk]["bn_down"])
+            j += 1
+        n_ckpt = sum(1 for k in state_dict
+                     if k.startswith(f"layer{i + 1}.")
+                     and k.endswith(".conv1.weight"))
+        _check_layer_count(n_ckpt, j)
+
+    params["fc"]["kernel"] = _set_leaf(
+        params["fc"]["kernel"], _to_np(state_dict["fc.weight"]).T)
+    params["fc"]["bias"] = _set_leaf(
+        params["fc"]["bias"], _to_np(state_dict["fc.bias"]))
+    out = dict(variables)
+    out["params"] = params
+    out["batch_stats"] = stats
+    return out
 
 
 # --------------------------------------------------------------------- #

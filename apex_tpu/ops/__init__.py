@@ -30,6 +30,11 @@ from apex_tpu.ops.mlp import (
     fused_dense,
 )
 from apex_tpu.ops.group_norm import group_norm, GroupNorm
+from apex_tpu.ops.batch_norm import (
+    batch_norm_train,
+    batch_norm_inference,
+    batch_norm_reference,
+)
 from apex_tpu.ops.attention import fused_attention, attention_reference
 from apex_tpu.ops.multihead_attn import SelfMultiheadAttn, EncdecMultiheadAttn
 
@@ -41,6 +46,7 @@ __all__ = [
     "softmax_cross_entropy", "softmax_cross_entropy_reference",
     "FusedDense", "FusedDenseGeluDense", "MLP", "fused_dense",
     "group_norm", "GroupNorm",
+    "batch_norm_train", "batch_norm_inference", "batch_norm_reference",
     "fused_attention", "attention_reference",
     "SelfMultiheadAttn", "EncdecMultiheadAttn",
 ]

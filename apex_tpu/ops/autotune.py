@@ -43,7 +43,7 @@ import time
 from typing import Dict, Iterable, Optional
 
 __all__ = ["cached_block_rows", "tune_layer_norm", "tune_softmax",
-           "clear_cache"]
+           "tune_batch_norm", "clear_cache"]
 
 _CACHE: Optional[Dict[str, int]] = None
 
@@ -185,6 +185,37 @@ def tune_softmax(n_rows: int = 8192, width: int = 512,
                  candidates)
 
 
+def tune_batch_norm(n_rows: int = 65536, width: int = 256,
+                    dtype="bfloat16",
+                    candidates: Iterable[int] = _DEFAULT_CANDIDATES) -> int:
+    """Sweep block-rows for the fused BatchNorm forward (reduce +
+    apply) at (n_rows, width).  The cache key is fp32 — the kernels'
+    VMEM blocks are sized by the fp32 compute copy regardless of the
+    activation dtype (see ``batch_norm._pick_rows``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops import batch_norm as _bn
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (n_rows, width),
+                          jnp.dtype(dtype))
+    w2 = jnp.ones((1, width), jnp.float32)
+    b2 = jnp.zeros((1, width), jnp.float32)
+
+    def build(br):
+        if n_rows % br:
+            raise ValueError("block must divide rows")
+        spec = _bn._Spec(eps=1e-5, act="relu", axes=(),
+                         impl="pallas", br=br, interpret=False,
+                         has_res=False)
+        fn = jax.jit(lambda x: _bn._fwd_compute(spec, x, w2, b2,
+                                                None)[0])
+        return fn, (x,)
+
+    return _tune("batch_norm", build, n_rows, width, "float32",
+                 candidates)
+
+
 def main(argv=None):
     import argparse
 
@@ -193,12 +224,13 @@ def main(argv=None):
     p.add_argument("--rows", type=int, default=8192)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--ops", nargs="+", default=["layer_norm", "softmax"],
-                   choices=["layer_norm", "softmax"])
+                   choices=["layer_norm", "softmax", "batch_norm"])
     args = p.parse_args(argv)
     for width in args.widths:
         for op in args.ops:
             tune = {"layer_norm": tune_layer_norm,
-                    "softmax": tune_softmax}[op]
+                    "softmax": tune_softmax,
+                    "batch_norm": tune_batch_norm}[op]
             best = tune(n_rows=args.rows, width=width, dtype=args.dtype)
             print(f"{op} w={width}: best block_rows={best} "
                   f"(cache: {_cache_path()})")

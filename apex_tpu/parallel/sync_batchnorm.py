@@ -11,6 +11,14 @@ summing (Σx, Σx², n) — exact, one fused ``psum`` over the DP axes — and
 the backward's two stat reductions fall out of JAX transposing the same
 ``psum``s.  No kernels, no process groups, bit-level agreement with a
 single-device BN on the concatenated batch (tested).
+
+``fused=True`` routes the train-mode math through
+:func:`apex_tpu.ops.batch_norm.batch_norm_train` — the fused Pallas
+kernels (one reduction + one map per direction, optional residual-add
++ ReLU epilogue) whose per-channel partial Σx/Σx² are ``psum``'d over
+the same axes, so the SyncBN leg shares the single-pass path.  The
+``act``/``residual`` epilogue also works unfused (applied as separate
+jnp ops) so the two modes stay drop-in interchangeable.
 """
 
 from __future__ import annotations
@@ -66,9 +74,21 @@ class SyncBatchNorm(nn.Module):
     use_bias: bool = True
     axis_names: Optional[Sequence[str]] = (DATA_AXIS,)
     param_dtype: jnp.dtype = jnp.float32
+    #: route train-mode math through the fused Pallas/custom-vjp op
+    #: (apex_tpu.ops.batch_norm) — same semantics, single-pass bwd
+    fused: bool = False
+    #: optional fused epilogue: None | "relu" (applied after the
+    #: residual add when a residual is passed to __call__)
+    act: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, use_running_average: Optional[bool] = None):
+    def __call__(self, x, use_running_average: Optional[bool] = None,
+                 residual=None):
+        from apex_tpu.ops.batch_norm import (
+            batch_norm_inference,
+            batch_norm_train,
+        )
+
         use_ra = nn.merge_param(
             "use_running_average", self.use_running_average,
             use_running_average)
@@ -83,46 +103,54 @@ class SyncBatchNorm(nn.Module):
                            self.param_dtype) if self.use_bias else None)
 
         if use_ra:
-            mean, var = ra_mean.value, ra_var.value
+            return batch_norm_inference(
+                x, ra_mean.value, ra_var.value, scale, bias,
+                eps=self.epsilon, residual=residual, act=self.act)
+
+        reduce_dims = tuple(range(x.ndim - 1))
+        axes = _present_axes(self.axis_names)
+        if self.fused:
+            y, mean, var = batch_norm_train(
+                x, scale, bias, eps=self.epsilon, residual=residual,
+                act=self.act, axis_names=axes)
         else:
-            reduce_dims = tuple(range(x.ndim - 1))
-            axes = _present_axes(self.axis_names)
             mean, var = sync_batch_norm_stats(
                 x, axes, reduce_dims=reduce_dims)
-            if not self.is_initializing():
-                m = self.momentum
-                # torch SyncBatchNorm stores the *unbiased* (Bessel-
-                # corrected) variance in running_var; normalization
-                # itself stays biased
-                n_elem = 1
-                for d in reduce_dims:
-                    n_elem *= x.shape[d]
-                for a in axes:
-                    n_elem *= lax.axis_size(a)
-                rvar = var * (n_elem / (n_elem - 1)) if n_elem > 1 else var
-                ra_mean.value = m * ra_mean.value + (1 - m) * mean
-                ra_var.value = m * ra_var.value + (1 - m) * rvar
-
-        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
-        if scale is not None:
-            y = y * scale.astype(jnp.float32)
-        if bias is not None:
-            y = y + bias.astype(jnp.float32)
-        return y.astype(x.dtype)
+            yf = (x.astype(jnp.float32) - mean) * lax.rsqrt(
+                var + self.epsilon)
+            if scale is not None:
+                yf = yf * scale.astype(jnp.float32)
+            if bias is not None:
+                yf = yf + bias.astype(jnp.float32)
+            if residual is not None:
+                yf = yf + residual.astype(jnp.float32)
+            if self.act == "relu":
+                yf = jnp.maximum(yf, 0.0)
+            elif self.act is not None:
+                raise ValueError(f"unknown act {self.act!r}")
+            y = yf.astype(x.dtype)
+        if not self.is_initializing():
+            m = self.momentum
+            # torch SyncBatchNorm stores the *unbiased* (Bessel-
+            # corrected) variance in running_var; normalization
+            # itself stays biased
+            n_elem = 1
+            for d in reduce_dims:
+                n_elem *= x.shape[d]
+            for a in axes:
+                n_elem *= lax.axis_size(a)
+            rvar = var * (n_elem / (n_elem - 1)) if n_elem > 1 else var
+            ra_mean.value = m * ra_mean.value + (1 - m) * mean
+            ra_var.value = m * ra_var.value + (1 - m) * rvar
+        return y
 
 
 def _present_axes(axis_names):
-    """Keep only axis names actually bound in the current trace."""
-    if not axis_names:
-        return ()
-    out = []
-    for a in axis_names:
-        try:
-            lax.axis_size(a)
-            out.append(a)
-        except (NameError, KeyError):  # axis not bound in this trace
-            continue
-    return tuple(out)
+    """Keep only axis names actually bound in the current trace
+    (shared with the fused op — one probe implementation)."""
+    from apex_tpu.ops.batch_norm import _bound_axes
+
+    return _bound_axes(axis_names)
 
 
 def convert_syncbn_model(module: nn.Module) -> nn.Module:

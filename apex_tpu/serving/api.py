@@ -165,6 +165,7 @@ class InferenceServer:
     # ------------------------------------------------------------- intake
     def submit(self, prompt, *, max_new_tokens: int,
                temperature: float = 0.0, top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
                eos_id: Optional[int] = None, seed: int = 0,
                block: bool = True,
                timeout: Optional[float] = None) -> RequestHandle:
@@ -173,7 +174,7 @@ class InferenceServer:
             prompt=np.asarray(prompt, np.int32).reshape(-1),
             max_new_tokens=int(max_new_tokens),
             temperature=float(temperature),
-            top_k=top_k, eos_id=eos_id, seed=int(seed))
+            top_k=top_k, top_p=top_p, eos_id=eos_id, seed=int(seed))
         # the handle must be reachable by the worker BEFORE the request
         # enters the queue: run_step doesn't take _wakeup, so a fast
         # worker can admit — even finish — a one-token request between

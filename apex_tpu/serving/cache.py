@@ -135,8 +135,9 @@ class SlotState(NamedTuple):
 
     Sampling params ride here as DEVICE ARRAYS (not static jit args):
     a slot decoding greedily and a slot sampling at ``temperature=1.2,
-    top_k=40`` run in the same compiled step.  Conventions:
-    ``top_k == 0`` disables truncation, ``eos_id == -1`` disables eos
+    top_k=40, top_p=0.9`` run in the same compiled step.  Conventions:
+    ``top_k == 0`` disables truncation, ``top_p <= 0`` (or ``>= 1``)
+    disables the nucleus filter, ``eos_id == -1`` disables eos
     stopping, and ``rng`` is a per-slot PRNG key so a request's sampled
     tokens are a function of its own seed, independent of co-tenants.
     """
@@ -147,6 +148,7 @@ class SlotState(NamedTuple):
     budget: jax.Array        # int32 — max_new_tokens for the tenant
     temperature: jax.Array   # float32
     top_k: jax.Array         # int32 — 0 = disabled
+    top_p: jax.Array         # float32 — <= 0 or >= 1 = disabled
     eos_id: jax.Array        # int32 — -1 = disabled
     rng: jax.Array           # uint32 (max_slots, 2) — per-slot key
 
@@ -162,13 +164,14 @@ def init_slot_state(max_slots: int) -> SlotState:
         budget=jnp.ones((max_slots,), jnp.int32),
         temperature=z(jnp.float32),
         top_k=z(jnp.int32),
+        top_p=z(jnp.float32),
         eos_id=jnp.full((max_slots,), -1, jnp.int32),
         rng=jnp.zeros((max_slots, 2), jnp.uint32),
     )
 
 
 def admit_slot(state: SlotState, slot, tok, budget, temperature,
-               top_k, eos_id, seed) -> SlotState:
+               top_k, top_p, eos_id, seed) -> SlotState:
     """Functional admission of one tenant into ``slot`` (traceable).
 
     ``seed`` derives the slot's private PRNG key inside the trace, so
@@ -184,6 +187,7 @@ def admit_slot(state: SlotState, slot, tok, budget, temperature,
         budget=state.budget.at[slot].set(budget),
         temperature=state.temperature.at[slot].set(temperature),
         top_k=state.top_k.at[slot].set(top_k),
+        top_p=state.top_p.at[slot].set(top_p),
         eos_id=state.eos_id.at[slot].set(eos_id),
         rng=state.rng.at[slot].set(key.astype(jnp.uint32)),
     )

@@ -5,9 +5,10 @@ executables for the engine's whole lifetime:
 
 - ``decode_step``  — ONE trace: vmap over slots of the model's
   ``decode=True`` single-token path, followed by branchless per-slot
-  sampling whose parameters (temperature / top_k / eos / budget) are
-  device arrays in :class:`~apex_tpu.serving.cache.SlotState` — mixed
-  sampling configs share the executable.
+  sampling whose parameters (temperature / top_k / top_p / eos /
+  budget) are device arrays in
+  :class:`~apex_tpu.serving.cache.SlotState` — mixed sampling configs
+  (nucleus sampling included) share the executable.
 - ``prefill``      — one trace PER PROMPT BUCKET: the prompt, right-
   padded to its bucket length, runs through the shared chunked-prefill
   path (``apex_tpu.models.generate.prefill_tokens``) into a fresh
@@ -60,16 +61,24 @@ __all__ = ["Engine", "sample_dynamic", "DEFAULT_BUCKETS"]
 DEFAULT_BUCKETS: Tuple[int, ...] = (32, 128, 512)
 
 
-def sample_dynamic(logits, keys, temperature, top_k, vocab_size: int):
+def sample_dynamic(logits, keys, temperature, top_k, top_p,
+                   vocab_size: int):
     """Branchless per-row sampling with DEVICE-ARRAY parameters.
 
     ``logits`` (rows, vocab); ``keys`` (rows, 2) uint32; ``temperature``
-    / ``top_k`` (rows,).  Per row: fp32 argmax when ``temperature <= 0``
-    else top-k-truncated categorical at ``logits/temperature``
-    (``top_k == 0`` disables truncation).  The math mirrors
-    ``generate``'s static :func:`~apex_tpu.models.generate.sample_logits`
-    — kth-largest threshold on the scaled logits, ``-1e30`` mask — but
-    every parameter is traced, so one executable serves any mix.
+    / ``top_k`` / ``top_p`` (rows,).  Per row: fp32 argmax when
+    ``temperature <= 0`` else top-k- and/or nucleus-truncated
+    categorical at ``logits/temperature`` (``top_k == 0`` and
+    ``top_p <= 0`` / ``>= 1`` disable their filters — a disabled
+    filter is an exact no-op, not an epsilon approximation).  The math
+    mirrors ``generate``'s static
+    :func:`~apex_tpu.models.generate.sample_logits` — kth-largest /
+    nucleus threshold on the scaled logits, ``-1e30`` mask, top-k
+    before top-p (the HF warper order) — but every parameter is
+    traced, so one executable serves any mix.  The nucleus pass reuses
+    the top-k sort (the post-mask order is the pre-mask order with the
+    masked tail replaced), so mixed top-p traffic costs no second
+    O(V·logV) sort.
     """
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -80,6 +89,21 @@ def sample_dynamic(logits, keys, temperature, top_k, vocab_size: int):
     kth = jnp.take_along_axis(
         ordered, (vocab_size - k)[:, None], axis=-1)     # k-th largest
     scaled = jnp.where(scaled < kth, -1e30, scaled)
+    # nucleus filter over the top-k-masked distribution, sort reused:
+    # descending masked order = reversed `ordered` with the SAME
+    # `< kth` criterion applied that masked `scaled` — value-based,
+    # not position-based, so k-th-boundary ties survive in both or
+    # neither (keeps engine/generate parity in tie cases)
+    p_on = (top_p > 0.0) & (top_p < 1.0)                 # (rows,)
+    rev = ordered[:, ::-1]
+    desc = jnp.where(rev < kth, -1e30, rev)
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < jnp.where(p_on, top_p, 1.0)[:, None]
+    thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    scaled = jnp.where(p_on[:, None] & (scaled < thresh), -1e30,
+                       scaled)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     sampled = sampled.astype(jnp.int32)
     return jnp.where(temperature > 0.0, sampled, greedy)
@@ -170,7 +194,8 @@ class Engine:
             logits, pool = jax.vmap(one_slot)(pool, state.tok)
             split = jax.vmap(jax.random.split)(state.rng)
             nxt = sample_dynamic(logits, split[:, 0],
-                                 state.temperature, state.top_k, vocab)
+                                 state.temperature, state.top_k,
+                                 state.top_p, vocab)
             produced = state.produced + state.active.astype(jnp.int32)
             hit_budget = produced >= state.budget
             hit_eos = (state.eos_id >= 0) & (nxt == state.eos_id)
@@ -190,11 +215,11 @@ class Engine:
             return slot_cache.rewind_index_leaves(filled, true_len - 1)
 
         def admit(pool, state, slot, one, tok, budget, temperature,
-                  top_k, eos_id, seed):
+                  top_k, top_p, eos_id, seed):
             pool = slot_cache.write_slot(pool, slot, one)
             state = slot_cache.admit_slot(
-                state, slot, tok, budget, temperature, top_k, eos_id,
-                seed)
+                state, slot, tok, budget, temperature, top_k, top_p,
+                eos_id, seed)
             return pool, state
 
         def release(pool, state, slot):
@@ -232,7 +257,8 @@ class Engine:
 
     def validate_request(self, prompt_len: int, max_new_tokens: int,
                          temperature: float = 0.0,
-                         top_k: Optional[int] = None) -> int:
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None) -> int:
         """Static admission checks; returns the prompt's bucket."""
         if prompt_len < 1:
             raise ValueError("empty prompt")
@@ -250,11 +276,16 @@ class Engine:
             raise ValueError(
                 f"top_k must be in [1, vocab_size={self.vocab_size}] "
                 f"(or 0/None to disable), got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1] (or None to disable), "
+                f"got {top_p}")
         del temperature      # any float is admissible (<=0 -> greedy)
         return bucket
 
     def admit(self, slot: int, prompt, *, max_new_tokens: int,
               temperature: float = 0.0, top_k: Optional[int] = None,
+              top_p: Optional[float] = None,
               eos_id: Optional[int] = None, seed: int = 0) -> None:
         """Prefill ``prompt`` (1-D int tokens) and install it in
         ``slot``.  The caller owns slot accounting (the scheduler's
@@ -262,7 +293,7 @@ class Engine:
         replaces the tenant."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         bucket = self.validate_request(
-            prompt.shape[0], max_new_tokens, temperature, top_k)
+            prompt.shape[0], max_new_tokens, temperature, top_k, top_p)
         if not 0 <= slot < self.max_slots:
             raise ValueError(
                 f"slot must be in [0, {self.max_slots}), got {slot}")
@@ -274,6 +305,7 @@ class Engine:
             self.cache, self.state, np.int32(slot), one,
             np.int32(prompt[-1]), np.int32(max_new_tokens),
             np.float32(temperature), np.int32(top_k or 0),
+            np.float32(0.0 if top_p is None else top_p),
             np.int32(-1 if eos_id is None else eos_id),
             np.uint32(seed))
 

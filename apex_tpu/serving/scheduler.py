@@ -37,8 +37,9 @@ class QueueFull(RuntimeError):
 class Request:
     """One generation request (host object).
 
-    ``top_k=None``/``0`` disables truncation, ``eos_id=None`` disables
-    eos stopping, ``seed`` derives the request's private sampling key
+    ``top_k=None``/``0`` disables truncation, ``top_p=None``/``1.0``
+    disables the nucleus filter, ``eos_id=None`` disables eos
+    stopping, ``seed`` derives the request's private sampling key
     (tokens are a function of the request, not of its co-tenants).
     """
 
@@ -46,6 +47,7 @@ class Request:
     max_new_tokens: int
     temperature: float = 0.0
     top_k: Optional[int] = None
+    top_p: Optional[float] = None
     eos_id: Optional[int] = None
     seed: int = 0
     uid: int = -1                       # assigned by the scheduler
@@ -87,7 +89,7 @@ class Scheduler:
         prompt = np.asarray(request.prompt, np.int32).reshape(-1)
         self.engine.validate_request(
             prompt.shape[0], request.max_new_tokens,
-            request.temperature, request.top_k)
+            request.temperature, request.top_k, request.top_p)
         request.prompt = prompt
         with self._lock:
             if len(self._queue) >= self.queue_capacity:
@@ -130,6 +132,7 @@ class Scheduler:
                 max_new_tokens=req.max_new_tokens,
                 temperature=req.temperature,
                 top_k=req.top_k or 0,
+                top_p=req.top_p,
                 eos_id=req.eos_id,
                 seed=req.seed)
             self._slots[slot] = req

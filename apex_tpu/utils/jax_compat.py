@@ -1,0 +1,94 @@
+"""Version shims for the narrow band of jax APIs this library uses.
+
+The library targets current jax, where ``jax.shard_map`` and
+``jax.lax.axis_size`` are public; on the 0.4.x line still found on
+some TPU images those spell ``jax.experimental.shard_map.shard_map``
+(with ``check_rep`` instead of ``check_vma``) and
+``jax.core.axis_frame(name).size``.  :func:`install` backfills the
+missing public names with semantics-equivalent wrappers — called once
+from ``apex_tpu/__init__`` — so every call site (library, benches,
+tests) writes the current spelling.  On a jax that already has the
+APIs, install() is a no-op.
+
+This is the same revive-the-suite-on-this-jax move as the round-6
+``maybe_constrain`` degrade (CHANGES.md PR 2): ~50 seed tests fail on
+jax 0.4.37 purely on these two names.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+__all__ = ["install", "axis_size", "shard_map"]
+
+
+def _axis_size_fallback(axis_name):
+    """``lax.axis_size`` for jax builds that predate it: the bound
+    axis frame's size (raises ``NameError`` for unbound names, the
+    same contract callers probe with try/except)."""
+    if isinstance(axis_name, (tuple, list)):
+        n = 1
+        for a in axis_name:
+            n *= _axis_size_fallback(a)
+        return n
+    frame = jax.core.axis_frame(axis_name)
+    # 0.4.x returns the size directly in some traces, a frame object
+    # (with .size) in others
+    return getattr(frame, "size", frame)
+
+
+def _shard_map_fallback(f=None, *, mesh=None, in_specs=None,
+                        out_specs=None, check_vma=None,
+                        axis_names=None, **kw):
+    """``jax.shard_map`` for jax builds that only have the
+    experimental spelling: maps ``check_vma`` onto the old
+    ``check_rep`` and supports the no-positional decorator form.
+
+    The partial-manual ``axis_names`` subset is deliberately NOT
+    mapped onto the old ``auto`` complement: on 0.4.37 that lowering
+    aborts the process inside XLA:CPU's backend_compile (a C++ CHECK,
+    not a python error) — a clean ``TypeError`` here keeps the
+    partial-manual suites failing softly instead of killing the test
+    process.
+    """
+    from jax.experimental.shard_map import shard_map as _sm
+
+    if check_vma is not None:
+        kw.setdefault("check_rep", check_vma)
+    if axis_names is not None:
+        raise TypeError(
+            "shard_map(axis_names=...) (partial-manual) is not "
+            "supported by the jax_compat fallback on this jax "
+            "version — the old `auto` lowering hard-aborts XLA:CPU")
+    if f is None:
+        return functools.partial(
+            _shard_map_fallback, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs, **kw)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **kw)
+
+
+def axis_size(axis_name):
+    """The current-jax ``lax.axis_size`` regardless of version."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return _axis_size_fallback(axis_name)
+
+
+def shard_map(*args, **kw):
+    """The current-jax ``jax.shard_map`` regardless of version."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(*args, **kw)
+    return _shard_map_fallback(*args, **kw)
+
+
+def install() -> None:
+    """Backfill ``jax.shard_map`` / ``jax.lax.axis_size`` when the
+    running jax lacks them (no-op otherwise)."""
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = _axis_size_fallback
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_fallback

@@ -102,7 +102,7 @@ def _measure(state, step, batch, samples_per_step, extra=None,
 # ----------------------------------------------------------------- ResNet-50
 
 def _resnet_traffic_model(b, size, stage_sizes=(3, 4, 6, 3), width=64,
-                          act_bytes=2):
+                          act_bytes=2, fused_bn=False):
     """Analytic HBM-traffic model of a ResNet train step (round-4
     verdict weak #1: XLA's cost-model "bytes accessed" double-counts
     fusion-internal traffic by an uncalibrated amount, so the resnet
@@ -122,6 +122,21 @@ def _resnet_traffic_model(b, size, stage_sizes=(3, 4, 6, 3), width=64,
     roofline_frac scored against ``bn_real`` is ≤ 1 by construction
     and *means something*: 1.0 = the step streams exactly its
     architecture-mandated bytes at peak bandwidth.
+
+    ``fused_bn=True`` adds a third key, ``bn_fused_kernel``: the pass
+    count the ISSUE-3 fused kernels (apex_tpu/ops/batch_norm.py)
+    actually execute — per BN'd activation, fwd = stats read +
+    normalize read/write (+3 beyond floor: the kernels materialize the
+    normalized tensor instead of folding the per-channel affine into
+    the consumer conv, which ``bn_real`` idealizes away), bwd = one
+    (dy, x) reduction + one (dy, x) map writing dx (+5) — so +8 passes
+    vs ``bn_real``'s idealized +2.  It is the *kernel program's own
+    mandated traffic*: measured fused steps land between
+    ``bn_real`` and ``bn_fused_kernel``, and the leg's score stays
+    against ``bn_real`` so A/B rows share one bound.  Note the
+    space-to-depth stem does not move any bound — (224·224·3) and
+    (112·112·12) are the same element count; its win (no 3-channel
+    patch materialization) lives in the overhead above the bound.
     """
     convs = []                            # (in_elems, out_elems, bn?)
     hw = size // 2                        # stem s=2
@@ -151,12 +166,23 @@ def _resnet_traffic_model(b, size, stage_sizes=(3, 4, 6, 3), width=64,
     # read+write, fp32 grad read (+ its bf16 write in bwd)
     n_params = 25.6e6
     param_traffic = n_params * (4 * 2 + 4 * 2 + 4 + 2)
-    return {"floor": int(floor + param_traffic),
-            "bn_real": int(floor + bn_extra + param_traffic)}
+    out = {"floor": int(floor + param_traffic),
+           "bn_real": int(floor + bn_extra + param_traffic)}
+    if fused_bn:
+        fused_extra = sum(8 * o for _, o, bn in convs if bn) \
+            * b * act_bytes
+        out["bn_fused_kernel"] = int(floor + fused_extra
+                                     + param_traffic)
+    return out
 
 
 def _build_resnet(opt_level, sync_bn):
-    """ResNet-50 train state (examples/imagenet/main_amp.py workload)."""
+    """ResNet-50 train state (examples/imagenet/main_amp.py workload).
+
+    BENCH_RESNET_FUSED_BN=1 routes BN through the fused kernels
+    (ops/batch_norm.py); BENCH_RESNET_STEM=s2d swaps in the MLPerf
+    space-to-depth stem — the ISSUE-3 A/B levers.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -174,7 +200,9 @@ def _build_resnet(opt_level, sync_bn):
         num_classes=1000,
         bn_axis_names=("data",) if sync_bn else None,
         dtype=jnp.bfloat16 if opt_level in ("O1", "O2", "O3")
-        else jnp.float32)
+        else jnp.float32,
+        fused_bn=os.environ.get("BENCH_RESNET_FUSED_BN") == "1",
+        stem=os.environ.get("BENCH_RESNET_STEM", "conv"))
     model = ResNet(cfg)
 
     rng = np.random.default_rng(0)
@@ -195,9 +223,58 @@ def _build_resnet(opt_level, sync_bn):
     return model, state, batch_stats, (images, labels), b
 
 
+# the fused-BN × s2d-stem A/B grid (ISSUE 3): each row runs in a fresh
+# child process (HBM is not reclaimed promptly across builds)
+_RESNET_VARIANTS = {
+    # both keys always explicit (None = remove from the child env) so
+    # an ambient BENCH_RESNET_* can't leak into the wrong row
+    "base": {"BENCH_RESNET_FUSED_BN": None, "BENCH_RESNET_STEM": None},
+    "fused_bn": {"BENCH_RESNET_FUSED_BN": "1",
+                 "BENCH_RESNET_STEM": None},
+    "s2d": {"BENCH_RESNET_FUSED_BN": None,
+            "BENCH_RESNET_STEM": "s2d"},
+    "fused_bn_s2d": {"BENCH_RESNET_FUSED_BN": "1",
+                     "BENCH_RESNET_STEM": "s2d"},
+}
+
+
+def _resnet_ab(leg, variants):
+    """Orchestrate the fused/s2d A/B rows for a resnet leg; the main
+    row is the fully-fused config (the production recommendation), and
+    ``ab`` quantifies each lever against base on the shared bn_real
+    bound."""
+    rows = {}
+    for name in variants:
+        rows[name] = _run_child(
+            leg, dict(_RESNET_VARIANTS[name],
+                      BENCH_RESNET_VARIANT="1"), timeout=2700)
+    main = dict(rows.get("fused_bn_s2d") or {})
+    ab = {}
+    base = rows.get("base") or {}
+    for name in variants:
+        row = rows.get(name) or {}
+        if name != "base" and row.get("value") and base.get("value"):
+            ab[f"{name}_vs_base_speedup"] = round(
+                row["value"] / base["value"], 3)
+        if row.get("roofline_frac") is not None:
+            ab[f"{name}_frac_of_bn_real"] = row["roofline_frac"]
+    _emit({
+        "metric": main.get("metric", leg) + "_ab",
+        "value": main.get("value"),
+        "unit": "samples/sec/chip (fused_bn + s2d stem)",
+        "rows": rows,
+        "ab": ab,
+    })
+
+
 def bench_resnet50_o1():
     import jax
     import jax.numpy as jnp
+
+    if not os.environ.get("BENCH_RESNET_VARIANT"):
+        _resnet_ab("resnet50_o1",
+                   ("base", "fused_bn", "s2d", "fused_bn_s2d"))
+        return
 
     _, state, batch_stats, (images, labels), b = _build_resnet("O1", False)
 
@@ -229,13 +306,19 @@ def _resnet_rescore(out, b):
     """Re-score roofline_frac against the analytic traffic model (see
     :func:`_resnet_traffic_model`); the XLA cost-model frac stays as a
     diagnostic.  Guarantees frac ≤ 1 up to clock noise and makes the
-    near-ceiling resnet captures certify something real."""
+    near-ceiling resnet captures certify something real.  The frac is
+    ALWAYS vs ``bn_real`` (so fused/unfused A/B rows share one bound);
+    fused rows additionally record their kernels' own mandated bytes
+    (``bn_fused_kernel``)."""
     import jax
 
+    fused = os.environ.get("BENCH_RESNET_FUSED_BN") == "1"
+    out["fused_bn"] = fused
+    out["stem"] = os.environ.get("BENCH_RESNET_STEM", "conv")
     if jax.default_backend() != "tpu":
         return          # rooflines are chip certifications; CPU runs
     tm = _resnet_traffic_model(
-        b, int(os.environ.get("BENCH_IMAGE", "224")))
+        b, int(os.environ.get("BENCH_IMAGE", "224")), fused_bn=fused)
     dt = out["step_ms"] / 1e3
     t_hbm_real = tm["bn_real"] / (bench._PEAK_HBM_GBS * 1e9)
     t_mxu = out.get("mxu_bound_frac", 0.0) * dt
@@ -264,6 +347,12 @@ def bench_resnet50_syncbn():
 
     from apex_tpu.core import mesh as mesh_lib
     from apex_tpu.parallel import all_reduce_mean_grads
+
+    if not os.environ.get("BENCH_RESNET_VARIANT"):
+        # 2-row A/B (base vs fully fused): the per-lever split is the
+        # o1 leg's job; this leg certifies the psum'd fused-stats path
+        _resnet_ab("resnet50_syncbn", ("base", "fused_bn_s2d"))
+        return
 
     mesh = mesh_lib.initialize_mesh(data_parallel_size=-1)
     _, state, batch_stats, (images, labels), b = _build_resnet("O1", True)
@@ -1067,6 +1156,88 @@ def bench_mistral7b_tp8_full_step():
         "per_device_output_bytes": getattr(
             mem, "output_size_in_bytes", None),
     })
+
+
+def bench_moe_mixtral():
+    """Measured MoE throughput leg (ISSUE-3 satellite / round-5
+    verdict Missing #2: MoE was dryrun-correct and parity-tested but
+    had no on-chip row).  A Mixtral-geometry proxy — the 8x7b recipe
+    (hidden 4096, 8 SwiGLU experts, top-2 token-choice routing, GQA,
+    sliding window) at BENCH_MOE_LAYERS of its 32 layers, the same
+    full-geometry-proxy convention as ``gpt2_1p3b`` — trained one real
+    O2+FusedAdam+DLS step per measurement under the standard
+    best-of-window/agreement hygiene.  The router trains through
+    ``moe_aux_loss`` exactly as production would.
+
+    ``moe_capacity_factor`` defaults to the *training* value 1.25
+    (token drop is routine when training from scratch; the drop-free
+    parity default cf=4 makes the dispatch masks quadratic in S and is
+    an import-parity concern, not a throughput recipe) — override with
+    BENCH_MOE_CF.  BENCH_MOE_PRESET=tiny swaps in LlamaConfig.tiny
+    with the same expert structure for CPU smoke tests."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.models import (
+        LlamaConfig,
+        LlamaModel,
+        gpt_loss_fn,
+        moe_aux_loss,
+    )
+    from apex_tpu.optim import fused_adam
+
+    preset = os.environ.get("BENCH_MOE_PRESET", "mixtral")
+    b = int(os.environ.get("BENCH_BATCH", "1"))
+    s = int(os.environ.get("BENCH_SEQ", "1024"))
+    cf = float(os.environ.get("BENCH_MOE_CF", "1.25"))
+    if preset == "tiny":
+        cfg = LlamaConfig.tiny(
+            max_seq_len=s, num_moe_experts=4, moe_top_k=2,
+            moe_capacity_factor=cf, scan_layers=False)
+    else:
+        cfg = LlamaConfig.mixtral_8x7b(
+            max_seq_len=s, dtype=jnp.bfloat16, remat=True,
+            scan_layers=False, moe_capacity_factor=cf,
+            # 2 of 32 layers fits the chip beside the O2 state; the
+            # per-layer geometry (the thing measured) is full-size
+            num_layers=int(os.environ.get("BENCH_MOE_LAYERS", "2")))
+    model = LlamaModel(cfg)
+
+    ids = jax.random.randint(
+        jax.random.PRNGKey(0), (b, s + 1), 0, cfg.vocab_size, jnp.int32)
+    inputs, labels = ids[:, :-1], ids[:, 1:]
+    params = model.init(jax.random.PRNGKey(0), inputs[:1, :8])
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    state = amp.initialize(
+        model.apply, params,
+        fused_adam(1e-4, moment_dtype=jnp.bfloat16),
+        opt_level="O2", half_dtype=jnp.bfloat16)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, inputs, labels):
+        def loss_fn(p):
+            cp = state.policy.cast_to_compute(p)
+            logits, mut = state.apply_fn(cp, inputs,
+                                         mutable=["losses"])
+            loss = gpt_loss_fn(logits, labels) + moe_aux_loss(mut)
+            return state.scale_loss(loss), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
+        new_state, finite = state.apply_gradients(grads=grads)
+        return new_state, loss, finite
+
+    out = _measure(state, step, (inputs, labels), b,
+                   {"batch": b, "seq": s,
+                    "num_layers": cfg.num_layers,
+                    "num_experts": cfg.num_moe_experts,
+                    "moe_top_k": cfg.moe_top_k,
+                    "moe_capacity_factor": cf,
+                    "num_params": int(n_params)})
+    out["tokens_per_sec"] = round(out["value"] * s, 1)
+    out["metric"] = (f"moe_mixtral_proxy{cfg.num_layers}L_O2_fusedadam"
+                     "_samples_per_sec_per_chip")
+    _emit(out)
 
 
 # ----------------------------------------------------------------- BERT O1
@@ -1897,6 +2068,7 @@ LEGS = {
     "gpt2_tp8_full_step": bench_gpt2_tp8_full_step,
     "gpt2_3d_full_step": bench_gpt2_3d_full_step,
     "mistral7b_tp8_full_step": bench_mistral7b_tp8_full_step,
+    "moe_mixtral": bench_moe_mixtral,
     "llama_1b": bench_llama_1b,
     "decode": bench_decode,
     "serving_decode": bench_serving_decode,
@@ -1914,7 +2086,9 @@ _CPU_LEGS = {"gpt2_tp8_full_step", "gpt2_3d_full_step",
 # own children's budgets (a parent timeout would discard every
 # already-measured child row)
 _LEG_TIMEOUT = {"decode": 10000, "llama_1b": 8000,
-                "long_context": 6600}
+                "long_context": 6600,
+                # A/B orchestrators: 4 (o1) / 2 (syncbn) child rows
+                "resnet50_o1": 11000, "resnet50_syncbn": 5600}
 
 
 def _run_all():

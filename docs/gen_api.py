@@ -30,7 +30,7 @@ PAGES = {
             "apex_tpu.ops.layer_norm", "apex_tpu.ops.softmax",
             "apex_tpu.ops.rope", "apex_tpu.ops.mlp",
             "apex_tpu.ops.xentropy", "apex_tpu.ops.group_norm",
-            "apex_tpu.ops.autotune"],
+            "apex_tpu.ops.batch_norm", "apex_tpu.ops.autotune"],
     "optim": ["apex_tpu.optim.fused_adam", "apex_tpu.optim.fused_lamb",
               "apex_tpu.optim.fused_sgd", "apex_tpu.optim.fused_novograd",
               "apex_tpu.optim.fused_adagrad",
@@ -69,7 +69,7 @@ PAGES = {
                 "apex_tpu.serving.scheduler", "apex_tpu.serving.cache"],
     "utils": ["apex_tpu.utils.checkpoint", "apex_tpu.utils.profiler",
               "apex_tpu.utils.debug", "apex_tpu.utils.metrics",
-              "apex_tpu.utils.tree"],
+              "apex_tpu.utils.tree", "apex_tpu.utils.jax_compat"],
     "fp16_utils": ["apex_tpu.fp16_utils"],
     "data": ["apex_tpu.data"],
 }

@@ -50,6 +50,12 @@ def parse_args():
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--sync-bn", action="store_true",
                    help="SyncBatchNorm over the data axis")
+    p.add_argument("--fused-bn", action="store_true",
+                   help="fused BN(+add+ReLU) kernels "
+                        "(apex_tpu.ops.batch_norm; docs/perf_resnet.md)")
+    p.add_argument("--stem", default="conv", choices=["conv", "s2d"],
+                   help="'s2d' = MLPerf space-to-depth stem (needs an "
+                        "even image size)")
     p.add_argument("--arch", default="resnet50",
                    choices=["resnet18", "resnet50"])
     p.add_argument("--data", default=None, metavar="FILE.npz",
@@ -78,7 +84,7 @@ def main():
     cfg = ResNetConfig(
         stage_sizes=stages, num_classes=args.num_classes,
         bn_axis_names=("data",) if args.sync_bn else None,
-        dtype=dtype)
+        dtype=dtype, fused_bn=args.fused_bn, stem=args.stem)
     model = ResNet(cfg)
 
     rng = np.random.default_rng(0)

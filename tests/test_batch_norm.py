@@ -1,0 +1,509 @@
+"""Fused BatchNorm(+add+ReLU) kernels and the ResNet traffic levers
+(ISSUE 3 tentpole).
+
+Contracts under test:
+- fwd AND bwd of ``batch_norm_train`` match flax ``nn.BatchNorm`` /
+  the jnp golden composition on BOTH dispatch paths (xla +
+  pallas_interpret), train and eval mode, with/without residual-add
+  and ReLU, odd channel counts (XLA-fallback envelope), bf16;
+- the space-to-depth stem computes exactly the 7×7/stride-2 conv
+  (weight-transform parity, model logits parity, torchvision-importer
+  compatibility);
+- the compiled resnet50 train step's cost-model bytes drop with
+  ``fused_bn=True`` + s2d stem (the without-a-chip half of the ISSUE-3
+  acceptance; the on-chip A/B rows in BENCH_CONFIGS.json are the real
+  certification).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.ops.batch_norm import (
+    batch_norm_inference,
+    batch_norm_reference,
+    batch_norm_train,
+)
+
+IMPLS = ("xla", "pallas_interpret")
+
+
+def _data(rng, shape=(4, 6, 6, 64), dtype=jnp.float32):
+    x = jnp.asarray(rng.normal(size=shape), dtype)
+    res = jnp.asarray(rng.normal(size=shape), dtype)
+    c = shape[-1]
+    w = jnp.asarray(rng.normal(size=(c,)) + 1.0, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(c,)), jnp.float32)
+    return x, res, w, b
+
+
+class TestFusedBatchNormGolden:
+    @pytest.mark.parametrize("impl", IMPLS)
+    @pytest.mark.parametrize("act", [None, "relu"])
+    @pytest.mark.parametrize("use_res", [False, True])
+    def test_forward_matches_reference(self, rng, impl, act, use_res):
+        x, res, w, b = _data(rng)
+        r = res if use_res else None
+        yr, mr, vr = batch_norm_reference(x, w, b, residual=r, act=act)
+        y, m, v = batch_norm_train(x, w, b, residual=r, act=act,
+                                   implementation=impl)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(m), np.asarray(mr),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(v), np.asarray(vr),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.l0
+    @pytest.mark.parametrize("impl", IMPLS)
+    @pytest.mark.parametrize("act", [None, "relu"])
+    @pytest.mark.parametrize("use_res", [False, True])
+    def test_backward_matches_autodiff_of_reference(self, rng, impl,
+                                                    act, use_res):
+        """The custom_vjp (single-reduction bwd, mask recompute, psum
+        hooks) must equal jax.grad through the plain composition —
+        including the mean/var output cotangents."""
+        x, res, w, b = _data(rng)
+        r = res if use_res else None
+        argnums = (0, 1, 2, 3) if use_res else (0, 1, 2)
+
+        def loss_ref(x, w, b, r):
+            y, m, v = batch_norm_reference(x, w, b, residual=r, act=act)
+            return (jnp.sum(y * jnp.cos(y)) + jnp.sum(m * 2.0)
+                    + jnp.sum(v * 3.0))
+
+        def loss_fused(x, w, b, r):
+            y, m, v = batch_norm_train(x, w, b, residual=r, act=act,
+                                       implementation=impl)
+            return (jnp.sum(y * jnp.cos(y)) + jnp.sum(m * 2.0)
+                    + jnp.sum(v * 3.0))
+
+        gr = jax.grad(loss_ref, argnums)(x, w, b, r)
+        gf = jax.grad(loss_fused, argnums)(x, w, b, r)
+        for a, bb in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_matches_flax_batchnorm(self, rng):
+        x, _, w, b = _data(rng)
+        bn = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                          epsilon=1e-5)
+        variables = bn.init(jax.random.PRNGKey(0), x)
+        variables = {"params": {"scale": w, "bias": b},
+                     "batch_stats": variables["batch_stats"]}
+        want, _ = bn.apply(variables, x, mutable=["batch_stats"])
+        for impl in IMPLS:
+            y, _, _ = batch_norm_train(x, w, b, implementation=impl)
+            np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_odd_channels_fall_back_and_match(self, rng):
+        # C=5: outside the kernel envelope — auto must dispatch to the
+        # XLA path and still match the reference; forcing pallas raises
+        x, res, w, b = _data(rng, shape=(4, 3, 3, 5))
+        y, m, v = batch_norm_train(x, w, b, residual=res, act="relu")
+        yr, mr, vr = batch_norm_reference(x, w, b, residual=res,
+                                          act="relu")
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   rtol=1e-5, atol=1e-5)
+        with pytest.raises(ValueError, match="envelope"):
+            batch_norm_train(x, w, b, implementation="pallas")
+
+    def test_bf16_within_tolerance(self, rng):
+        x, res, w, b = _data(rng, dtype=jnp.bfloat16)
+        yr, _, _ = batch_norm_reference(x, w, b, residual=res,
+                                        act="relu")
+        for impl in IMPLS:
+            y, _, _ = batch_norm_train(x, w, b, residual=res,
+                                       act="relu", implementation=impl)
+            np.testing.assert_allclose(
+                np.asarray(y, np.float32), np.asarray(yr, np.float32),
+                rtol=2e-2, atol=2e-2)
+
+    def test_inference_matches_syncbn_eval_math(self, rng):
+        x, _, w, b = _data(rng)
+        mean = jnp.asarray(rng.normal(size=(64,)), jnp.float32)
+        var = jnp.asarray(rng.random(size=(64,)) + 0.5, jnp.float32)
+        got = batch_norm_inference(x, mean, var, w, b, eps=1e-5)
+        want = ((x.astype(jnp.float32) - mean)
+                * jax.lax.rsqrt(var + 1e-5) * w + b).astype(x.dtype)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_validation(self, rng):
+        x, res, w, b = _data(rng)
+        with pytest.raises(ValueError, match="act"):
+            batch_norm_train(x, w, b, act="gelu")
+        with pytest.raises(ValueError, match="residual shape"):
+            batch_norm_train(x, w, b, residual=res[:2])
+
+
+class TestSyncBatchNormFusedLocal:
+    """fused=True through the module (single device — the cross-device
+    agreement lives in tests/test_parallel.py)."""
+
+    def test_module_fused_matches_unfused(self, rng):
+        from apex_tpu.parallel import SyncBatchNorm
+
+        x = jnp.asarray(rng.normal(size=(8, 4, 4, 16)), jnp.float32)
+        res = jnp.asarray(rng.normal(size=x.shape), jnp.float32)
+        for act, use_res in ((None, False), ("relu", False),
+                             ("relu", True)):
+            kw = dict(use_running_average=False, axis_names=None,
+                      act=act)
+            a = SyncBatchNorm(fused=False, **kw)
+            variables = a.init(jax.random.PRNGKey(0), x)
+            r = res if use_res else None
+            ya, mut_a = a.apply(variables, x, residual=r,
+                                mutable=["batch_stats"])
+            yb, mut_b = SyncBatchNorm(fused=True, **kw).apply(
+                variables, x, residual=r, mutable=["batch_stats"])
+            np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                                       rtol=1e-5, atol=1e-5)
+            for la, lb in zip(jax.tree.leaves(mut_a),
+                              jax.tree.leaves(mut_b)):
+                np.testing.assert_allclose(
+                    np.asarray(la), np.asarray(lb), rtol=1e-5,
+                    atol=1e-5)
+
+    def test_eval_mode_ignores_fused_flag(self, rng):
+        from apex_tpu.parallel import SyncBatchNorm
+
+        x = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+        variables = SyncBatchNorm(use_running_average=False).init(
+            jax.random.PRNGKey(0), x)
+        ya = SyncBatchNorm(use_running_average=True,
+                           fused=False).apply(variables, x)
+        yb = SyncBatchNorm(use_running_average=True,
+                           fused=True).apply(variables, x)
+        np.testing.assert_array_equal(np.asarray(ya), np.asarray(yb))
+
+
+def _tiny_resnet(**kw):
+    from apex_tpu.models.resnet import ResNet, ResNetConfig
+
+    kw.setdefault("stage_sizes", (1, 1))
+    return ResNet(ResNetConfig(num_classes=5, width=8, **kw))
+
+
+class TestResNetFusedBN:
+    def test_fused_matches_unfused(self, rng):
+        """Logits and batch_stats agree between the fused and plain BN
+        paths of the full model (all three _BN wirings: act-only,
+        residual+act, bare).  Gradient agreement lives in the slow
+        tier (the model-level grad compile costs ~30 s on CPU; the
+        per-op backward is golden-tested above)."""
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        m = _tiny_resnet()
+        mf = _tiny_resnet(fused_bn=True)
+        v = m.init(jax.random.PRNGKey(0), x, train=True)
+        out, mut = m.apply(v, x, train=True, mutable=["batch_stats"])
+        outf, mutf = mf.apply(v, x, train=True,
+                              mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(outf), np.asarray(out),
+                                   rtol=1e-4, atol=1e-4)
+        for a, b in zip(jax.tree.leaves(mut), jax.tree.leaves(mutf)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.slow
+    def test_fused_grads_match_unfused(self, rng):
+        # [slow: two whole-model grad compiles ≈ 30 s on CPU]
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        m = _tiny_resnet()
+        mf = _tiny_resnet(fused_bn=True)
+        v = m.init(jax.random.PRNGKey(0), x, train=True)
+
+        def loss(model, p):
+            out, _ = model.apply(
+                {"params": p, "batch_stats": v["batch_stats"]}, x,
+                train=True, mutable=["batch_stats"])
+            return jnp.sum(out ** 2)
+
+        g1 = jax.grad(lambda p: loss(m, p))(v["params"])
+        g2 = jax.grad(lambda p: loss(mf, p))(v["params"])
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4)
+
+    def test_eval_mode_parity(self, rng):
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        m = _tiny_resnet()
+        v = m.init(jax.random.PRNGKey(0), x, train=True)
+        a = m.apply(v, x, train=False)
+        b = _tiny_resnet(fused_bn=True).apply(v, x, train=False)
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestSpaceToDepthStem:
+    def test_conv_transform_exact(self, rng):
+        """4×4/s1 conv over s2d input with the transformed kernel ==
+        7×7/s2 conv (padding 3) over the raw input."""
+        from apex_tpu.models.resnet import (
+            space_to_depth,
+            stem_conv_to_s2d,
+        )
+
+        x = jnp.asarray(rng.normal(size=(2, 64, 64, 3)), jnp.float32)
+        w7 = jnp.asarray(rng.normal(size=(7, 7, 3, 16)), jnp.float32)
+        want = jax.lax.conv_general_dilated(
+            x, w7, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        got = jax.lax.conv_general_dilated(
+            space_to_depth(x), stem_conv_to_s2d(w7),
+            window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_model_logits_parity(self, rng):
+        from apex_tpu.models.resnet import convert_stem_to_s2d
+
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        m = _tiny_resnet()
+        ms = _tiny_resnet(stem="s2d")
+        v = m.init(jax.random.PRNGKey(0), x, train=True)
+        want, _ = m.apply(v, x, train=True, mutable=["batch_stats"])
+        got, _ = ms.apply(convert_stem_to_s2d(v), x, train=True,
+                          mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_space_to_depth_validation(self):
+        from apex_tpu.models.resnet import space_to_depth
+
+        with pytest.raises(ValueError, match="divisible"):
+            space_to_depth(jnp.zeros((1, 7, 8, 3)))
+
+    def test_bad_stem_config_raises(self, rng):
+        x = jnp.asarray(rng.normal(size=(1, 8, 8, 3)), jnp.float32)
+        m = _tiny_resnet(stem="wat")
+        with pytest.raises(ValueError, match="stem"):
+            m.init(jax.random.PRNGKey(0), x, train=True)
+
+
+class TestTorchResnetImport:
+    def _state_dict(self, rng, stage_sizes=(1, 2), width=8,
+                    num_classes=5):
+        sd = {}
+
+        def bn(prefix, c):
+            sd[prefix + ".weight"] = \
+                rng.normal(size=(c,)).astype(np.float32) + 1.0
+            sd[prefix + ".bias"] = \
+                rng.normal(size=(c,)).astype(np.float32)
+            sd[prefix + ".running_mean"] = \
+                rng.normal(size=(c,)).astype(np.float32)
+            sd[prefix + ".running_var"] = \
+                rng.random(size=(c,)).astype(np.float32) + 0.5
+
+        sd["conv1.weight"] = \
+            rng.normal(size=(width, 3, 7, 7)).astype(np.float32) * 0.1
+        bn("bn1", width)
+        cin = width
+        for i, nb in enumerate(stage_sizes):
+            f = width * (2 ** i)
+            for j in range(nb):
+                stride = 2 if (j == 0 and i > 0) else 1
+                p = f"layer{i + 1}.{j}"
+                sd[p + ".conv1.weight"] = rng.normal(
+                    size=(f, cin, 1, 1)).astype(np.float32) * 0.1
+                bn(p + ".bn1", f)
+                sd[p + ".conv2.weight"] = rng.normal(
+                    size=(f, f, 3, 3)).astype(np.float32) * 0.1
+                bn(p + ".bn2", f)
+                sd[p + ".conv3.weight"] = rng.normal(
+                    size=(4 * f, f, 1, 1)).astype(np.float32) * 0.1
+                bn(p + ".bn3", 4 * f)
+                if stride != 1 or cin != 4 * f:
+                    sd[p + ".downsample.0.weight"] = rng.normal(
+                        size=(4 * f, cin, 1, 1)).astype(np.float32) \
+                        * 0.1
+                    bn(p + ".downsample.1", 4 * f)
+                cin = 4 * f
+        sd["fc.weight"] = rng.normal(
+            size=(num_classes, cin)).astype(np.float32) * 0.1
+        sd["fc.bias"] = rng.normal(
+            size=(num_classes,)).astype(np.float32)
+        return sd
+
+    def test_import_conv_and_s2d_agree(self, rng):
+        """The same torchvision-layout checkpoint loaded into the
+        plain and the s2d stem yields identical logits — the
+        weight-transform path of the importer."""
+        from apex_tpu.models.torch_import import load_torch_resnet
+
+        sd = self._state_dict(rng)
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        m = _tiny_resnet(stage_sizes=(1, 2))
+        v = load_torch_resnet(
+            m.init(jax.random.PRNGKey(0), x, train=True), sd)
+        want = m.apply(v, x, train=False)
+        # imported running stats are in play (eval mode): assert a
+        # checkpoint BN leaf actually landed
+        got_var = np.asarray(
+            v["batch_stats"]["bn_stem"]["SyncBatchNorm_0"]["var"])
+        np.testing.assert_allclose(got_var, sd["bn1.running_var"])
+
+        ms = _tiny_resnet(stage_sizes=(1, 2), stem="s2d")
+        vs = load_torch_resnet(
+            ms.init(jax.random.PRNGKey(0), x, train=True), sd,
+            stem="s2d")
+        got = ms.apply(vs, x, train=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_layer_count_mismatch_raises(self, rng):
+        from apex_tpu.models.torch_import import load_torch_resnet
+
+        sd = self._state_dict(rng, stage_sizes=(1, 1))
+        x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        m = _tiny_resnet(stage_sizes=(1, 2))
+        with pytest.raises((ValueError, KeyError)):
+            load_torch_resnet(
+                m.init(jax.random.PRNGKey(0), x, train=True), sd)
+
+
+class TestResnetTrafficModel:
+    def test_fused_kernel_bound_ordering(self):
+        import bench_configs
+
+        tm = bench_configs._resnet_traffic_model(128, 224,
+                                                 fused_bn=True)
+        assert tm["floor"] < tm["bn_real"] < tm["bn_fused_kernel"]
+        # the unfused call keeps the old two-key contract
+        tm0 = bench_configs._resnet_traffic_model(128, 224)
+        assert set(tm0) == {"floor", "bn_real"}
+        assert tm0["bn_real"] == tm["bn_real"]
+
+
+@pytest.mark.slow
+class TestResnet50BytesAccessed:
+    """ISSUE-3 acceptance, the without-a-chip half: compile (never
+    execute) the resnet50 train step at a training-shaped batch and
+    compare XLA's cost-model bytes.
+
+    Two assertions, because the cost model overcounts conv-internal
+    traffic (patch materializations — the repo's round-4/5 finding
+    that demoted cost-model rooflines to diagnostics), which dilutes
+    any BN-side win in the full-step total:
+
+    - the full-step counted bytes must drop ≥ 10% with fused_bn + s2d
+      (measured ≈ 13.7% at b=64/224/bf16);
+    - of the BN-attributable counted bytes (full step minus a
+      BN-free conv skeleton of the same architecture), the fused path
+      must eliminate ≥ 20% (measured ≈ 35%) — the ISSUE-3 "≥20%"
+      criterion scored on the denominator the levers can actually
+      touch.  The on-chip A/B rows (BENCH_CONFIGS.json) certify the
+      real-traffic frac.
+    """
+
+    B, SIZE = 64, 224
+
+    def _step_bytes(self, model, with_stats):
+        x = jnp.zeros((self.B, self.SIZE, self.SIZE, 3), jnp.bfloat16)
+        y = jnp.zeros((self.B,), jnp.int32)
+        if with_stats:
+            v = jax.eval_shape(
+                lambda: model.init(jax.random.PRNGKey(0), x,
+                                   train=True))
+
+            def step(params, bs, x, y):
+                def loss_fn(p):
+                    logits, mut = model.apply(
+                        {"params": p, "batch_stats": bs}, x,
+                        train=True, mutable=["batch_stats"])
+                    oh = jax.nn.one_hot(y, 1000)
+                    loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(
+                        logits.astype(jnp.float32)) * oh, axis=-1))
+                    return loss, mut["batch_stats"]
+
+                return jax.value_and_grad(loss_fn, has_aux=True)(
+                    params)
+
+            compiled = jax.jit(step).lower(
+                v["params"], v["batch_stats"], x, y).compile()
+        else:
+            v = jax.eval_shape(
+                lambda: model.init(jax.random.PRNGKey(0), x))
+
+            def step(params, x, y):
+                def loss_fn(p):
+                    logits = model.apply({"params": p}, x)
+                    oh = jax.nn.one_hot(y, 1000)
+                    return -jnp.mean(jnp.sum(jax.nn.log_softmax(
+                        logits.astype(jnp.float32)) * oh, axis=-1))
+
+                return jax.value_and_grad(loss_fn)(params)
+
+            compiled = jax.jit(step).lower(v["params"], x, y).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return float(ca["bytes accessed"])
+
+    def test_bytes_accessed_drop(self):
+        from apex_tpu.models.resnet import ResNet, ResNetConfig
+
+        cfg = ResNetConfig(stage_sizes=(3, 4, 6, 3), num_classes=1000,
+                           dtype=jnp.bfloat16)
+        base = self._step_bytes(ResNet(cfg), True)
+        fused = self._step_bytes(
+            ResNet(dataclasses.replace(cfg, fused_bn=True,
+                                       stem="s2d")), True)
+        skeleton = self._step_bytes(_ConvSkeleton(), False)
+        full_drop = 1.0 - fused / base
+        bn_attrib = base - skeleton
+        eliminated = (base - fused) / bn_attrib
+        assert fused < base, (base, fused)
+        assert full_drop >= 0.10, (
+            f"full-step cost-model bytes drop {full_drop:.3f} < 10% "
+            f"(base {base:.3e}, fused {fused:.3e})")
+        assert bn_attrib > 0, (base, skeleton)
+        assert eliminated >= 0.20, (
+            f"fused path eliminates only {eliminated:.3f} of the "
+            f"BN-attributable counted bytes (base {base:.3e}, fused "
+            f"{fused:.3e}, conv skeleton {skeleton:.3e})")
+
+
+class _SkelBlock(nn.Module):
+    """Bottleneck block with BN stripped (conv skeleton — the
+    denominator of the BN-attributable bytes measurement)."""
+
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        conv = lambda f, k, s, name: nn.Conv(
+            f, (k, k), (s, s), padding="SAME" if k > 1 else "VALID",
+            use_bias=False, dtype=jnp.bfloat16, name=name)
+        r = nn.relu(conv(self.features, 1, 1, "conv1")(x))
+        r = nn.relu(conv(self.features, 3, self.stride, "conv2")(r))
+        r = conv(self.features * 4, 1, 1, "conv3")(r)
+        if self.stride != 1 or x.shape[-1] != self.features * 4:
+            x = conv(self.features * 4, 1, self.stride,
+                     "downsample")(x)
+        return nn.relu(r + x)
+
+
+class _ConvSkeleton(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=jnp.bfloat16,
+                    name="stem")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        for i, nb in enumerate((3, 4, 6, 3)):
+            for j in range(nb):
+                x = _SkelBlock(64 * (2 ** i),
+                               stride=2 if (j == 0 and i > 0) else 1,
+                               name=f"s{i}b{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(1000, dtype=jnp.float32, name="fc")(x)

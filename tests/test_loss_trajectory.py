@@ -1,0 +1,92 @@
+"""L1-style loss-trajectory artifact (ISSUE-3 satellite / round-5
+verdict Missing #5): a few-hundred-step CPU training run comparing the
+O0 (pure fp32) and O2 (bf16 compute + fp32 masters + dynamic loss
+scaling) trajectories on the testing-commons toy GPT.
+
+The reference's L1 tests train the standalone models under each opt
+level and assert the loss curves agree within a band — the claim being
+that mixed precision changes *arithmetic*, not *optimization*.  Here:
+same data order, same init, FusedAdam, 300 steps; the trajectories
+must (a) both decrease substantially (the model actually trains) and
+(b) stay inside an agreement band wide enough for bf16 noise but far
+tighter than the training signal itself.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp
+from apex_tpu.models import gpt_loss_fn
+from apex_tpu.optim import fused_adam
+from apex_tpu.transformer.testing import standalone_gpt
+
+
+@pytest.mark.slow
+def test_o0_vs_o2_loss_trajectory_agreement():
+    steps = 300
+    b, s = 8, 32
+
+    model, init_params = standalone_gpt(seed=0, max_seq_len=s)
+    vocab = model.cfg.vocab_size
+    data_key = jax.random.PRNGKey(1234)
+    # a FIXED pool of 4 batches, cycled: fresh random tokens every
+    # step would leave nothing learnable (loss pinned at ≈ ln V) —
+    # the trajectory signal here is memorization speed
+    n_pool = 4
+    ids = jax.random.randint(data_key, (n_pool, b, s + 1), 0, vocab,
+                             jnp.int32)
+
+    def run(opt_level):
+        state = amp.initialize(
+            model.apply, {"params": init_params},
+            fused_adam(3e-4),
+            opt_level=opt_level,
+            half_dtype=jnp.bfloat16 if opt_level == "O2" else None)
+
+        @jax.jit
+        def step(state, chunk):
+            inputs, labels = chunk[:, :-1], chunk[:, 1:]
+
+            def loss_fn(p):
+                cp = state.policy.cast_to_compute(p)
+                logits = state.apply_fn(cp, inputs)
+                loss = gpt_loss_fn(logits.astype(jnp.float32), labels)
+                return state.scale_loss(loss), loss
+
+            grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
+            new_state, _finite = state.apply_gradients(grads=grads)
+            return new_state, loss
+
+        losses = []
+        for i in range(steps):
+            state, loss = step(state, ids[i % n_pool])
+            losses.append(float(loss))
+        return np.asarray(losses)
+
+    l_o0 = run("O0")
+    l_o2 = run("O2")
+    assert np.all(np.isfinite(l_o0)) and np.all(np.isfinite(l_o2))
+
+    # (a) both trajectories train: the tail loss must sit well below
+    # the head (toy GPT memorizes this stream fast)
+    head0, tail0 = l_o0[:10].mean(), l_o0[-20:].mean()
+    head2, tail2 = l_o2[:10].mean(), l_o2[-20:].mean()
+    assert tail0 < head0 - 1.0, (head0, tail0)
+    assert tail2 < head2 - 1.0, (head2, tail2)
+
+    # (b) agreement band: smoothed trajectories track each other to a
+    # small fraction of the total training signal.  Window-averaged
+    # (single-step losses are noisy under bf16), band = 10% of the
+    # O0 head→tail drop, floored at 0.25 nats.
+    band = max(0.1 * (head0 - tail0), 0.25)
+    k = 20
+    smooth0 = np.convolve(l_o0, np.ones(k) / k, mode="valid")
+    smooth2 = np.convolve(l_o2, np.ones(k) / k, mode="valid")
+    gap = np.abs(smooth0 - smooth2).max()
+    assert gap <= band, (
+        f"O0/O2 smoothed trajectories diverge by {gap:.3f} nats "
+        f"(band {band:.3f}); head/tail O0 {head0:.3f}/{tail0:.3f} "
+        f"O2 {head2:.3f}/{tail2:.3f}")

@@ -221,6 +221,73 @@ class TestGreedyParity:
         assert got[-1] == eos and len(got) < n
 
 
+class TestTopPSampling:
+    @pytest.mark.slow
+    def test_top_p_one_matches_disabled(self, gpt):
+        # [slow: two engine builds ≈ 8 s; the fast tier covers the
+        # exact-no-op contract at the sample_dynamic level below and
+        # mixes top_p=1.0 traffic through the zero-retrace soak]
+        """top_p=1.0 and top_p=None are the same program AND the same
+        tokens (the disabled nucleus filter is an exact no-op in
+        sample_dynamic, not an epsilon approximation)."""
+        model, params = gpt
+        rng = np.random.default_rng(23)
+        prompt = rng.integers(0, model.cfg.vocab_size,
+                              size=(6,)).astype(np.int32)
+
+        def run(top_p):
+            engine = Engine(model, params, max_slots=1,
+                            prompt_buckets=(8,))
+            sched = Scheduler(engine)
+            req = sched.submit(Request(
+                prompt=prompt, max_new_tokens=6, temperature=0.9,
+                top_p=top_p, seed=5))
+            sched.drain()
+            return list(req.tokens)
+
+        assert run(None) == run(1.0)
+
+    def test_dynamic_nucleus_restricts_tokens(self, gpt):
+        """sample_dynamic with a per-slot top_p must only emit tokens
+        from each row's nucleus; disabled rows are exact no-ops."""
+        from apex_tpu.serving.engine import sample_dynamic
+
+        rng = np.random.default_rng(3)
+        V = 32
+        logits = jnp.asarray(rng.normal(size=(2, V)) * 3.0,
+                             jnp.float32)
+        temp = jnp.asarray([0.8, 0.8], jnp.float32)
+        top_k = jnp.zeros((2,), jnp.int32)
+        top_p = jnp.asarray([0.6, 0.0], jnp.float32)
+        probs = np.asarray(jax.nn.softmax(logits / 0.8, axis=-1))[0]
+        order = np.argsort(-probs)
+        cum = np.cumsum(probs[order])
+        nucleus = set(order[:int(np.searchsorted(cum, 0.6)) + 1]
+                      .tolist())
+        seen0, seen1 = set(), set()
+        for i in range(200):
+            keys = np.stack([np.asarray([i, 1], np.uint32),
+                             np.asarray([i, 2], np.uint32)])
+            out = sample_dynamic(logits, jnp.asarray(keys), temp,
+                                 top_k, top_p, V)
+            seen0.add(int(out[0]))
+            seen1.add(int(out[1]))
+        assert seen0 <= nucleus, (seen0, nucleus)
+        # the disabled row samples from the full distribution — it
+        # must escape the nucleus at least once across 200 draws
+        assert any(t not in nucleus for t in seen1)
+
+    def test_top_p_validation_at_submit(self, gpt):
+        model, params = gpt
+        engine = Engine(model, params, max_slots=1,
+                        prompt_buckets=(8,))
+        sched = Scheduler(engine)
+        with pytest.raises(ValueError, match="top_p"):
+            sched.submit(Request(prompt=np.zeros(4, np.int32),
+                                 max_new_tokens=2, temperature=1.0,
+                                 top_p=1.5))
+
+
 class TestSamplingDeterminism:
     def test_tokens_independent_of_cotenants(self, gpt):
         """A sampled request carries its own rng (seeded at admission):
@@ -254,12 +321,15 @@ class TestSamplingDeterminism:
 class TestSoakZeroRetraces:
     def test_steady_state_soak(self, gpt):
         """The acceptance soak: >= 3 prompt-length buckets, mixed
-        temperatures / top_k / eos / budgets, admissions and evictions
-        interleaving across 14 requests through 3 slots — zero jaxpr
-        traces after warmup.  The engine's retrace_guards (budget:
-        decode_step/admit/release = 1, prefill = #buckets) raise
-        RetraceError on any excess trace, and the process-wide
-        trace-event counter cross-checks the whole soak."""
+        temperatures / top_k / top_p / eos / budgets, admissions and
+        evictions interleaving across 14 requests through 3 slots —
+        zero jaxpr traces after warmup.  The engine's retrace_guards
+        (budget: decode_step/admit/release = 1, prefill = #buckets)
+        raise RetraceError on any excess trace, and the process-wide
+        trace-event counter cross-checks the whole soak.  Nucleus
+        (top_p) traffic rides the same executable as everything else
+        (the ISSUE-3 plumbing contract: per-slot device-array
+        params, budgets unchanged)."""
         model, params = gpt
         engine = Engine(model, params, max_slots=3,
                         prompt_buckets=(4, 8, 16))
@@ -271,20 +341,23 @@ class TestSoakZeroRetraces:
         rng = np.random.default_rng(11)
         before = tracecheck.trace_event_count()
         cases = [
-            (3, 4, 0.0, None, None), (7, 3, 0.8, 20, None),
-            (12, 5, 1.2, 5, None), (2, 6, 0.0, None, 17),
-            (8, 2, 0.5, None, None), (16, 4, 0.0, None, None),
-            (5, 3, 1.0, 50, 3), (4, 5, 0.0, None, None),
-            (9, 4, 0.7, 10, None), (1, 2, 0.0, None, None),
-            (13, 3, 1.5, 2, None), (6, 6, 0.0, None, 900),
-            (11, 2, 0.9, None, None), (8, 4, 0.0, None, None),
+            (3, 4, 0.0, None, None, None),
+            (7, 3, 0.8, 20, None, None),
+            (12, 5, 1.2, 5, None, 0.9), (2, 6, 0.0, None, 17, None),
+            (8, 2, 0.5, None, None, 0.5),
+            (16, 4, 0.0, None, None, None),
+            (5, 3, 1.0, 50, 3, 0.95), (4, 5, 0.0, None, None, None),
+            (9, 4, 0.7, 10, None, None), (1, 2, 0.0, None, None, None),
+            (13, 3, 1.5, 2, None, 1.0), (6, 6, 0.0, None, 900, None),
+            (11, 2, 0.9, None, None, 0.7),
+            (8, 4, 0.0, None, None, None),
         ]
         reqs = []
-        for i, (L, n, t, k, eos) in enumerate(cases):
+        for i, (L, n, t, k, eos, p) in enumerate(cases):
             reqs.append(sched.submit(Request(
                 prompt=rng.integers(0, model.cfg.vocab_size,
                                     size=(L,)).astype(np.int32),
-                max_new_tokens=n, temperature=t, top_k=k,
+                max_new_tokens=n, temperature=t, top_k=k, top_p=p,
                 eos_id=eos, seed=i)))
         events = sched.drain()
         assert tracecheck.trace_event_count() == before, (
@@ -292,7 +365,7 @@ class TestSoakZeroRetraces:
         assert engine.trace_counts == {
             "decode_step": 1, "prefill": 3, "admit": 1, "release": 1}
         # every request produced tokens and respected its budget
-        for (L, n, t, k, eos), r in zip(cases, reqs):
+        for (L, n, t, k, eos, p), r in zip(cases, reqs):
             assert 1 <= len(r.tokens) <= n
             if eos is None:
                 assert len(r.tokens) == n

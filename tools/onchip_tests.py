@@ -22,6 +22,7 @@ import time
 
 SUBSET = [
     "tests/test_attention.py",
+    "tests/test_batch_norm.py",    # fused BN(+add+ReLU) kernels (ISSUE 3)
     "tests/test_layer_norm.py",
     "tests/test_ops.py",
     "tests/test_optim.py",
