@@ -57,6 +57,7 @@ from apex_tpu import optim
 from apex_tpu import parallel
 from apex_tpu import transformer
 from apex_tpu import contrib
+from apex_tpu import resilience
 from apex_tpu import serving
 from apex_tpu import utils
 
@@ -81,6 +82,7 @@ __all__ = [
     "parallel",
     "transformer",
     "contrib",
+    "resilience",
     "serving",
     "utils",
 ]

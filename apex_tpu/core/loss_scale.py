@@ -144,6 +144,22 @@ class DynamicLossScale:
         form of apex's "skip optimizer.step() on overflow"."""
         return tree_select(grads_finite, new_tree, old_tree)
 
+    def backoff_exhausted(self, state: LossScaleState) -> jnp.ndarray:
+        """Device-side flag: the scale is pinned at ``min_scale``.
+
+        Skip-and-halve can absorb a transient overflow burst, but once
+        the scale has backed all the way off, further non-finite steps
+        are NOT a loss-scaling artifact — the model (or data) itself is
+        producing NaN/inf, and no amount of skipping will recover.
+        This is the hand-off signal from the scaler's own state machine
+        to the next rung of the escalation ladder
+        (:class:`apex_tpu.resilience.ResilientLoop` rewinds to the last
+        good checkpoint when its NaN sentinel trips with this flag up,
+        and includes it in the divergence diagnostic either way).
+        """
+        return state.loss_scale <= jnp.asarray(self.min_scale,
+                                               jnp.float32)
+
 
 class StaticLossScale(DynamicLossScale):
     """Constant loss scale (``amp.initialize(..., loss_scale=128.0)``).

@@ -12,9 +12,13 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, Callable, Iterable, Iterator, Optional
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional, Tuple, Type
 
 import jax
+
+from apex_tpu.resilience import faults
+from apex_tpu.utils.metrics import counters
 
 __all__ = ["PrefetchLoader", "prefetch_to_device"]
 
@@ -28,17 +32,58 @@ class PrefetchLoader:
     shardings matching the batch structure) applied in ``device_put`` —
     e.g. ``NamedSharding(mesh, P("data"))`` to scatter the batch over
     the data axis while the previous step runs.
+
+    ``retries``/``retry_backoff``: bounded retry for FLAKY sources.  A
+    pull that raises one of ``retryable`` (default: ``OSError`` — GCS
+    blips, NFS hiccups — plus the resilience layer's
+    :class:`~apex_tpu.resilience.faults.TransientError`) is retried up
+    to ``retries`` times with exponential backoff
+    (``retry_backoff * 2**attempt`` seconds) before the error surfaces
+    in the consumer; the attempt counter resets on every successful
+    batch, so the budget bounds *consecutive* failures, not lifetime
+    ones.  Retrying assumes the source's ``__next__`` is safe to call
+    again after the failure — true of readers that fail *fetching*, not
+    of plain generators (a generator that raises is dead; wrap the
+    flaky I/O inside it instead).  Retries count on the
+    ``data.retry`` counter and the ``data.next`` fault-injection site
+    exercises the path.
     """
 
     def __init__(self, source: Iterable[Any], *, sharding=None,
                  buffer_size: int = 2,
-                 transform: Optional[Callable[[Any], Any]] = None):
+                 transform: Optional[Callable[[Any], Any]] = None,
+                 retries: int = 0, retry_backoff: float = 0.05,
+                 retryable: Tuple[Type[BaseException], ...] = (
+                     OSError, faults.TransientError)):
         if buffer_size < 1:
             raise ValueError("buffer_size must be >= 1")
+        if retries < 0:
+            raise ValueError("retries must be >= 0")
+        if retry_backoff < 0:
+            raise ValueError("retry_backoff must be >= 0")
         self._source = source
         self._sharding = sharding
         self._buffer_size = buffer_size
         self._transform = transform
+        self._retries = int(retries)
+        self._retry_backoff = float(retry_backoff)
+        self._retryable = tuple(retryable)
+
+    def _pull(self, it: Iterator[Any], stop: threading.Event) -> Any:
+        """One batch from the source, retrying retryable failures."""
+        attempt = 0
+        while True:
+            try:
+                faults.inject("data.next")
+                return next(it)
+            except StopIteration:
+                raise
+            except self._retryable:
+                if attempt >= self._retries or stop.is_set():
+                    raise
+                counters.inc("data.retry")
+                time.sleep(self._retry_backoff * (2 ** attempt))
+                attempt += 1
 
     def __iter__(self) -> Iterator[Any]:
         q: "queue.Queue" = queue.Queue(maxsize=self._buffer_size)
@@ -47,7 +92,12 @@ class PrefetchLoader:
 
         def worker():
             try:
-                for batch in self._source:
+                it = iter(self._source)
+                while True:
+                    try:
+                        batch = self._pull(it, stop)
+                    except StopIteration:
+                        return
                     if stop.is_set():
                         return
                     if self._transform is not None:

@@ -1,0 +1,67 @@
+"""apex_tpu.resilience — fault injection, preemption-safe checkpoints,
+and a self-healing training loop.
+
+The reference apex workflow (save model + optimizer + ``amp.state_dict()``
+together, restore, keep training) assumes the job dies and comes back;
+this package supplies the *how*: a seedable deterministic fault-injection
+registry (:mod:`~apex_tpu.resilience.faults`), checkpoints that survive
+being killed mid-save (:mod:`~apex_tpu.resilience.checkpointing`), and a
+:class:`~apex_tpu.resilience.trainer.ResilientLoop` that turns
+preemption signals, NaN bursts and hung steps into checkpoints, rewinds
+and diagnostic reports instead of lost work.  ``docs/resilience.md`` is
+the narrative guide.
+"""
+
+from apex_tpu.resilience.faults import (
+    FaultError,
+    FaultPlan,
+    FaultSpec,
+    InjectedIOError,
+    Preempted,
+    TransientError,
+    TransientStepError,
+    active,
+    clear_plan,
+    current_plan,
+    inject,
+    install_plan,
+    plan_from_env,
+)
+from apex_tpu.resilience.checkpointing import (
+    CheckpointCorrupt,
+    ResilientCheckpointer,
+    verify_checkpoint,
+    write_manifest,
+)
+from apex_tpu.resilience.trainer import (
+    DivergenceError,
+    LoopReport,
+    ResilientLoop,
+    WatchdogConfig,
+    WatchdogTimeout,
+)
+
+__all__ = [
+    "FaultError",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedIOError",
+    "Preempted",
+    "TransientError",
+    "TransientStepError",
+    "active",
+    "clear_plan",
+    "current_plan",
+    "inject",
+    "install_plan",
+    "plan_from_env",
+    "CheckpointCorrupt",
+    "ResilientCheckpointer",
+    "verify_checkpoint",
+    "write_manifest",
+    "DivergenceError",
+    "LoopReport",
+    "ResilientLoop",
+    "WatchdogConfig",
+    "WatchdogTimeout",
+]

@@ -1,0 +1,378 @@
+"""Deterministic, seedable fault injection.
+
+Production TPU fleets lose hosts to preemption, feed pipelines to flaky
+storage, and training runs to NaN bursts — but none of those failure
+modes appear on demand, so the code paths that are supposed to absorb
+them rot untested.  This module makes failure a first-class, *replayable*
+input: a :class:`FaultPlan` names which fault fires at which call-site
+on which step, and instrumented code asks :func:`inject` at each site.
+
+Call-sites instrumented across the tree (grep ``faults.inject`` for the
+live list):
+
+==================== ==============================================
+site                 where
+==================== ==============================================
+``train.step``       :class:`~apex_tpu.resilience.trainer.ResilientLoop`,
+                     once per step before the step function runs
+``checkpoint.save``  :class:`~apex_tpu.resilience.checkpointing.
+                     ResilientCheckpointer`, once per logical
+                     checkpoint, keyed by the TRAINING step
+``checkpoint.write`` :func:`apex_tpu.utils.checkpoint.save_checkpoint`,
+                     once per physical write (site call counter),
+                     before the staged write begins
+``serving.step``     ``InferenceServer._serve``, before each scheduler
+                     step
+``serving.admit``    ``Scheduler._admit_from_queue``, before each
+                     engine admission
+``data.next``        ``PrefetchLoader``'s worker, around each pull
+                     from the source iterator
+==================== ==============================================
+
+Fault kinds and their behavior when fired:
+
+- ``"io"``      — raises :class:`InjectedIOError` (an ``OSError``) at
+  the site: host-I/O failure (checkpoint disk, data source).
+- ``"transient"`` — raises :class:`TransientStepError`: a retryable
+  step failure (the serving loop's recover-and-requeue contract).
+- ``"nan"``     — *advisory*: returned from :func:`inject` so the site
+  can poison its own arrays (a synthetic NaN burst; raising would not
+  reproduce how NaNs actually arrive — silently, in the data).
+- ``"slow"`` / ``"stall"`` — sleeps ``delay`` seconds at the site
+  (straggler step / hung data loader), then is also returned.
+- ``"preempt"`` — SIGTERM-style preemption: re-raises ``SIGTERM``
+  through the process signal machinery when a handler is installed
+  (exercising the real preemption path of
+  :class:`~apex_tpu.resilience.trainer.ResilientLoop`), else raises
+  :class:`Preempted` directly.
+
+Determinism: whether a spec fires at ``(site, step)`` is a pure
+function of ``(plan.seed, spec index, site, step)`` — probability-based
+specs hash those into [0, 1) rather than consulting a live RNG — so a
+failing chaos run replays exactly from its plan.  Each firing
+increments a ``fault.<kind>`` counter on
+:data:`apex_tpu.utils.metrics.counters`.
+
+Entry point: set ``APEX_TPU_FAULT_PLAN`` to a plan's JSON (or
+``@/path/to/plan.json``) and the first :func:`inject` call loads it —
+soaks and real jobs opt into chaos without code changes.  This is a
+host-side, call-time read (never trace-time), so it is jit-safe.
+
+Usage::
+
+    plan = FaultPlan.parse('{"faults": [
+        {"site": "train.step", "kind": "preempt", "step": 120},
+        {"site": "checkpoint.save", "kind": "io", "prob": 0.1},
+        {"site": "serving.step", "kind": "transient", "every": 50}]}')
+    with faults.active(plan):
+        loop.run(state, data_fn, num_steps)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import signal
+import threading
+import time
+import zlib
+from typing import Dict, Optional, Sequence, Tuple
+
+from apex_tpu.utils.metrics import counters
+
+__all__ = [
+    "FaultError",
+    "InjectedIOError",
+    "TransientError",
+    "TransientStepError",
+    "Preempted",
+    "FaultSpec",
+    "FaultPlan",
+    "inject",
+    "install_plan",
+    "clear_plan",
+    "current_plan",
+    "active",
+    "plan_from_env",
+]
+
+PLAN_ENV = "APEX_TPU_FAULT_PLAN"
+
+
+class FaultError(RuntimeError):
+    """Base class for every *injected* fault raised by :func:`inject`."""
+
+
+class InjectedIOError(FaultError, OSError):
+    """Injected host-I/O failure (``kind="io"``) — an ``OSError`` so
+    code with realistic ``except OSError`` handling absorbs it."""
+
+
+class TransientError(RuntimeError):
+    """A failure the raiser declares RETRYABLE: the operation may be
+    re-attempted without corrupting state.  Integrations (data sources,
+    step wrappers) raise subclasses to opt into the retry/requeue
+    paths; anything else is treated as fatal."""
+
+
+class TransientStepError(TransientError):
+    """Retryable serving-step failure (``kind="transient"``).
+
+    ``slots`` optionally names the poisoned slot indices; ``None``
+    means attribution is unknown and every active slot is suspect.
+    Raised host-side *before* any device dispatch, so engine state is
+    intact and recovery is eviction + requeue, not a restart.
+    """
+
+    def __init__(self, message: str = "injected transient step fault",
+                 slots: Optional[Sequence[int]] = None):
+        super().__init__(message)
+        self.slots = None if slots is None else tuple(int(s) for s in slots)
+
+
+class Preempted(Exception):
+    """The job was preempted (``kind="preempt"`` with no SIGTERM
+    handler installed, or raised by code that wants preemption
+    semantics without a signal)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One fault: where (``site``), what (``kind``), and when.
+
+    When-clauses compose as AND; a spec with none of ``step`` /
+    ``steps`` / ``every`` / ``prob`` fires on every call to its site
+    (bounded by ``times``).
+
+    ``step``   — fire exactly at this step.
+    ``steps``  — fire at any step in this collection.
+    ``every``  — fire when ``step % every == 0``.
+    ``prob``   — fire with this probability, hashed deterministically
+    from ``(plan.seed, spec index, site, step)``.
+    ``times``  — at most this many total firings (``None`` = unbounded).
+    ``delay``  — seconds slept by ``slow`` / ``stall`` kinds.
+    ``slots``  — slot attribution carried by ``transient`` faults.
+    """
+
+    site: str
+    kind: str
+    step: Optional[int] = None
+    steps: Optional[Tuple[int, ...]] = None
+    every: Optional[int] = None
+    prob: Optional[float] = None
+    times: Optional[int] = None
+    delay: float = 0.05
+    slots: Optional[Tuple[int, ...]] = None
+
+    KINDS = ("io", "transient", "nan", "slow", "stall", "preempt")
+
+    def __post_init__(self):
+        if self.kind not in self.KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; one of {self.KINDS}")
+        if self.every is not None and self.every < 1:
+            raise ValueError(f"every must be >= 1, got {self.every}")
+        if self.prob is not None and not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"prob must be in [0, 1], got {self.prob}")
+        if self.steps is not None:
+            object.__setattr__(self, "steps",
+                               tuple(int(s) for s in self.steps))
+        if self.slots is not None:
+            object.__setattr__(self, "slots",
+                               tuple(int(s) for s in self.slots))
+
+    def matches(self, site: str, step: int, seed: int, index: int) -> bool:
+        """Pure when-clause evaluation — no mutable state consulted."""
+        if site != self.site:
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        if self.steps is not None and step not in self.steps:
+            return False
+        if self.every is not None and step % self.every != 0:
+            return False
+        if self.prob is not None:
+            key = f"{seed}:{index}:{site}:{step}".encode()
+            u = zlib.crc32(key) / 2.0 ** 32
+            if u >= self.prob:
+                return False
+        return True
+
+
+class FaultPlan:
+    """A seedable schedule of :class:`FaultSpec` firings.
+
+    Holds the only mutable injection state: per-spec fire counts (for
+    ``times`` caps) and per-site call counters (the implicit ``step``
+    when a site doesn't pass one).  :meth:`reset` rewinds both, so one
+    plan object replays identically across runs.  Thread-safe — the
+    serving worker, the prefetch worker and the training loop may all
+    inject against one plan.
+    """
+
+    def __init__(self, faults: Sequence[FaultSpec] = (), *, seed: int = 0):
+        self.faults: Tuple[FaultSpec, ...] = tuple(faults)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._fired: Dict[int, int] = {}
+        self._site_calls: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ state
+    def reset(self) -> None:
+        """Rewind fire counts and site counters (fresh replay)."""
+        with self._lock:
+            self._fired.clear()
+            self._site_calls.clear()
+
+    def fire_count(self, spec_index: int) -> int:
+        """How many times spec ``spec_index`` has fired so far."""
+        with self._lock:
+            return self._fired.get(spec_index, 0)
+
+    # ------------------------------------------------------------ match
+    def _arm(self, site: str, step: Optional[int]) -> Tuple[
+            Tuple[int, FaultSpec], ...]:
+        """Which specs fire for this call (and bump the counters)."""
+        with self._lock:
+            if step is None:
+                step = self._site_calls.get(site, 0)
+                self._site_calls[site] = step + 1
+            hits = []
+            for i, spec in enumerate(self.faults):
+                if not spec.matches(site, int(step), self.seed, i):
+                    continue
+                if spec.times is not None \
+                        and self._fired.get(i, 0) >= spec.times:
+                    continue
+                self._fired[i] = self._fired.get(i, 0) + 1
+                hits.append((i, spec))
+            return tuple(hits)
+
+    # ------------------------------------------------------- (de)serialize
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        """Build a plan from its JSON form: ``{"seed": 0, "faults":
+        [{"site": ..., "kind": ..., ...}, ...]}``."""
+        blob = json.loads(text)
+        specs = [FaultSpec(**{k: v for k, v in f.items()})
+                 for f in blob.get("faults", [])]
+        return cls(specs, seed=blob.get("seed", 0))
+
+    def to_json(self) -> str:
+        """Inverse of :meth:`parse` (runtime counters excluded)."""
+        return json.dumps({
+            "seed": self.seed,
+            "faults": [
+                {k: v for k, v in dataclasses.asdict(s).items()
+                 if v is not None and not (k == "delay" and v == 0.05)}
+                for s in self.faults],
+        })
+
+
+# ---------------------------------------------------------------- registry
+_UNSET = object()
+_plan_lock = threading.Lock()
+_plan = _UNSET      # _UNSET -> consult the env on first use; None -> off
+
+
+def plan_from_env(env: str = PLAN_ENV) -> Optional[FaultPlan]:
+    """Parse a plan from ``$APEX_TPU_FAULT_PLAN`` (JSON inline, or
+    ``@/path`` to a JSON file); ``None`` when unset/empty."""
+    raw = os.environ.get(env, "").strip()
+    if not raw:
+        return None
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    return FaultPlan.parse(raw)
+
+
+def install_plan(plan: Optional[FaultPlan]) -> None:
+    """Make ``plan`` the process-wide active plan (``None`` disables
+    injection, including the env entry point)."""
+    global _plan
+    with _plan_lock:
+        _plan = plan
+
+
+def clear_plan() -> None:
+    """Remove any active plan and re-arm the env entry point."""
+    global _plan
+    with _plan_lock:
+        _plan = _UNSET
+
+
+def current_plan() -> Optional[FaultPlan]:
+    """The active plan — loading ``$APEX_TPU_FAULT_PLAN`` on first use."""
+    global _plan
+    with _plan_lock:
+        if _plan is _UNSET:
+            _plan = plan_from_env()
+        return _plan
+
+
+@contextlib.contextmanager
+def active(plan: FaultPlan):
+    """Scope ``plan`` as the active plan (tests/soaks); restores the
+    previous registry state on exit."""
+    global _plan
+    with _plan_lock:
+        prev = _plan
+        _plan = plan
+    try:
+        yield plan
+    finally:
+        with _plan_lock:
+            _plan = prev
+
+
+def inject(site: str, step: Optional[int] = None) -> Tuple[FaultSpec, ...]:
+    """Fire any scheduled faults for ``site`` at ``step``.
+
+    Raising kinds (``io`` / ``transient`` / ``preempt``) raise here;
+    sleeping kinds (``slow`` / ``stall``) sleep here.  Advisory kinds
+    (``nan``, plus any spec that slept) are returned so the site can
+    apply them itself.  With no active plan this is one lock-free-ish
+    check — cheap enough for per-step call-sites.  ``step=None`` uses
+    the site's own monotone call counter.
+    """
+    plan = current_plan()
+    if plan is None:
+        return ()
+    hits = plan._arm(site, step)
+    if not hits:
+        return ()
+    advisory = []
+    for _i, spec in hits:
+        counters.inc(f"fault.{spec.kind}")
+        if spec.kind == "io":
+            raise InjectedIOError(
+                f"injected I/O fault at {site!r} (step {step})")
+        if spec.kind == "transient":
+            raise TransientStepError(
+                f"injected transient fault at {site!r} (step {step})",
+                slots=spec.slots)
+        if spec.kind == "preempt":
+            _fire_preemption(site, step)
+            advisory.append(spec)
+            continue
+        if spec.kind in ("slow", "stall"):
+            time.sleep(spec.delay)
+        advisory.append(spec)
+    return tuple(advisory)
+
+
+def _fire_preemption(site: str, step: Optional[int]) -> None:
+    """SIGTERM-style preemption: go through the real signal machinery
+    when someone (i.e. ``ResilientLoop``) installed a handler, so the
+    injected path and the genuine scheduler-kill path are the same
+    code; with no handler installed the default action would kill the
+    process (including a test runner), so raise :class:`Preempted`
+    instead."""
+    handler = signal.getsignal(signal.SIGTERM)
+    if callable(handler) and handler is not signal.default_int_handler:
+        signal.raise_signal(signal.SIGTERM)
+        return
+    raise Preempted(f"injected preemption at {site!r} (step {step})")

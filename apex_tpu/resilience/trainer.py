@@ -1,0 +1,476 @@
+"""Self-healing training loop: preemption, NaN escalation, watchdog.
+
+:class:`ResilientLoop` wraps any ``step_fn(carry, batch) -> (carry,
+aux)`` train step (the examples' jitted steps fit unchanged) with the
+three recoveries a long-running preemptible job needs:
+
+**Preemption hook** — SIGTERM/SIGINT set a flag; at the next step
+boundary the loop writes a final checkpoint and returns cleanly with
+``report.preempted = True``.  The next invocation auto-resumes from
+:meth:`ResilientCheckpointer.restore_latest` — kill → relaunch → same
+trajectory.
+
+**NaN/divergence sentinel** — the escalation ladder beyond
+:class:`~apex_tpu.core.loss_scale.DynamicLossScale` (whose own state
+machine already *skips* non-finite steps):
+
+1. *skip* — the loss scaler's job; the sentinel just counts.
+2. *rewind* — ``nan_tolerance`` CONSECUTIVE non-finite steps mean
+   skipping isn't working (cf.
+   :meth:`~apex_tpu.core.loss_scale.DynamicLossScale.backoff_exhausted`):
+   restore the last good checkpoint and replay.  This heals transient
+   corruption (a bad host, bit-flipped activations); a *deterministic*
+   NaN — bad data, bad LR — will recur on replay, which is exactly why
+   rewinds are capped.
+3. *abort* — after ``max_rewinds`` rewinds, raise
+   :class:`DivergenceError` carrying a diagnostic report (step, loss
+   scale, backoff state, counters) instead of burning the fleet on a
+   loop that cannot converge.
+
+**Step-time watchdog** — an EWMA of step latency sets a deadline
+(``max(min_deadline, deadline_factor × ewma)``); a step still running
+at its deadline gets every live thread's stack plus device/mesh state
+dumped (the straggler post-mortem) and, once the step does return,
+:class:`WatchdogTimeout` is raised — a silently-hung collective becomes
+a loud, attributable failure.
+
+Fault-injection sites: ``train.step`` (before the step, outside the
+watchdog window) and ``train.compute`` (inside the armed window, where
+a ``slow`` fault impersonates a straggler).  See
+:mod:`apex_tpu.resilience.faults`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.resilience import faults
+from apex_tpu.resilience.checkpointing import ResilientCheckpointer
+from apex_tpu.utils.metrics import MetricsWriter, counters
+from apex_tpu.utils.tree import is_floating
+
+__all__ = [
+    "ResilientLoop",
+    "LoopReport",
+    "WatchdogConfig",
+    "WatchdogTimeout",
+    "DivergenceError",
+]
+
+
+class WatchdogTimeout(RuntimeError):
+    """A step overran its watchdog deadline (stacks already dumped)."""
+
+
+class DivergenceError(RuntimeError):
+    """The NaN sentinel exhausted its escalation ladder.
+
+    ``report`` (a :class:`LoopReport`) carries the diagnostic: where
+    it died, how many rewinds were spent, the loss-scale state, and
+    the resilience counters at abort time.
+    """
+
+    def __init__(self, message: str, report: "LoopReport"):
+        super().__init__(message)
+        self.report = report
+
+
+@dataclasses.dataclass
+class WatchdogConfig:
+    """Step-time watchdog tuning.
+
+    ``deadline_factor`` × EWMA(step seconds) is the deadline, floored
+    at ``min_deadline`` (compile-time spikes and host jitter must not
+    page anyone).  ``warmup_steps`` are observed but never policed —
+    step 0 includes compilation.  ``dump_path`` receives the stack /
+    mesh dump (``None`` = stderr).
+    """
+
+    deadline_factor: float = 10.0
+    min_deadline: float = 30.0
+    ewma_alpha: float = 0.1
+    warmup_steps: int = 1
+    poll: float = 0.05
+    dump_path: Optional[str] = None
+
+
+@dataclasses.dataclass
+class LoopReport:
+    """What a :meth:`ResilientLoop.run` did, machine-readable.
+
+    ``diagnostics`` is populated on abnormal exits (divergence abort)
+    and always includes the final counters snapshot.
+    """
+
+    start_step: int = 0
+    final_step: int = 0
+    steps_run: int = 0
+    resumed_from: Optional[int] = None
+    preempted: bool = False
+    rewinds: int = 0
+    nonfinite_steps: int = 0
+    checkpoints_saved: int = 0
+    watchdog_fired: bool = False
+    diagnostics: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class _Watchdog:
+    """Monitor thread policing one armed step at a time."""
+
+    def __init__(self, cfg: WatchdogConfig):
+        self.cfg = cfg
+        self.ewma: Optional[float] = None
+        self.fired_step: Optional[int] = None
+        self._lock = threading.Lock()
+        self._armed_step: Optional[int] = None
+        self._deadline_at: float = 0.0
+        self._observed = 0
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._watch, name="apex-tpu-watchdog", daemon=True)
+        self._thread.start()
+
+    def deadline(self) -> float:
+        if self.ewma is None:
+            return self.cfg.min_deadline
+        return max(self.cfg.min_deadline,
+                   self.cfg.deadline_factor * self.ewma)
+
+    def arm(self, step: int) -> None:
+        with self._lock:
+            if self._observed < self.cfg.warmup_steps:
+                return                      # compile steps: observe only
+            self._armed_step = step
+            self._deadline_at = time.monotonic() + self.deadline()
+
+    def disarm(self, dt: float) -> None:
+        with self._lock:
+            self._armed_step = None
+            self._observed += 1
+            a = self.cfg.ewma_alpha
+            self.ewma = dt if self.ewma is None \
+                else (1 - a) * self.ewma + a * dt
+
+    def stop(self) -> None:
+        self._stop = True
+        self._thread.join(timeout=5.0)
+
+    def _watch(self) -> None:
+        while not self._stop:
+            time.sleep(self.cfg.poll)
+            with self._lock:
+                step = self._armed_step
+                overdue = (step is not None
+                           and time.monotonic() > self._deadline_at)
+                if overdue:
+                    self._armed_step = None     # one dump per arm
+            if overdue:
+                self.fired_step = step
+                counters.inc("watchdog.fired")
+                self._dump(step)
+
+    def _dump(self, step: int) -> None:
+        lines: List[str] = [
+            f"=== apex_tpu watchdog: step {step} exceeded its "
+            f"{self.deadline():.1f}s deadline "
+            f"(ewma {self.ewma if self.ewma is None else round(self.ewma, 4)}s) ===",
+            "--- live thread stacks ---",
+        ]
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for ident, frame in frames.items():
+            lines.append(f"thread {names.get(ident, '?')} ({ident}):")
+            lines.extend(
+                l.rstrip() for l in traceback.format_stack(frame))
+        lines.append("--- device / mesh state ---")
+        try:
+            devs = jax.devices()
+            lines.append(f"backend={jax.default_backend()} "
+                         f"devices={len(devs)} "
+                         f"[{', '.join(str(d) for d in devs[:8])}"
+                         f"{', …' if len(devs) > 8 else ''}]")
+        except Exception as e:                        # noqa: BLE001
+            lines.append(f"device query failed: {e!r}")
+        try:
+            from apex_tpu.core import mesh as mesh_lib
+
+            lines.append(f"mesh={mesh_lib.get_mesh()!r}")
+        except Exception:                             # no live mesh
+            lines.append("mesh=<none>")
+        blob = "\n".join(lines) + "\n"
+        if self.cfg.dump_path:
+            with open(self.cfg.dump_path, "a") as f:
+                f.write(blob)
+        else:
+            sys.stderr.write(blob)
+
+
+def _poison_nan(carry: Any) -> Any:
+    """Multiply every floating leaf by NaN — the synthetic corruption
+    the ``"nan"`` fault kind injects (NaNs arrive in-band, as data, so
+    the fault must too)."""
+    bad = float("nan")
+    return jax.tree.map(
+        lambda x: x * bad if is_floating(x) else x, carry)
+
+
+class ResilientLoop:
+    """Run a train step under preemption/NaN/straggler protection.
+
+    Parameters
+    ----------
+    step_fn:
+        ``(carry, batch) -> (carry, aux)``.  ``carry`` is any pytree
+        (a :class:`~apex_tpu.core.train_state.MixedPrecisionTrainState`,
+        or a tuple of state + mutables); ``aux`` is returned to the
+        extractors below.
+    checkpointer / checkpoint_every:
+        Rolling :class:`~apex_tpu.resilience.checkpointing.
+        ResilientCheckpointer` cadence.  ``None`` disables persistence
+        (then preemption exits cleanly but resumes from scratch, and
+        the NaN ladder has no rewind rung).
+    async_checkpoints:
+        Periodic saves snapshot to host synchronously but serialize in
+        a background thread (the <2% steady-state overhead target of
+        the ``resilience_overhead`` bench leg); the final/preemption
+        save always blocks.
+    finite_of:
+        ``aux -> bool-ish`` feeding the NaN sentinel (e.g. the
+        ``grads_finite`` flag from ``apply_gradients``).  ``None``
+        disables the sentinel.
+    scalars_of:
+        ``aux -> dict`` of host floats for the metrics writer.
+    nan_tolerance / max_rewinds:
+        The escalation ladder thresholds (see the module docstring).
+    watchdog:
+        A :class:`WatchdogConfig`, or ``None`` to disable.  When armed
+        the loop blocks on ``aux`` so device time is attributed to the
+        step that spent it.
+    preempt_signals:
+        Signals treated as preemption (default ``SIGTERM``; add
+        ``SIGINT`` for ctrl-C-to-checkpoint).  Installed only when
+        running in the main thread; elsewhere the flag can still be
+        set via :meth:`request_preemption` or an injected ``preempt``
+        fault.
+    """
+
+    def __init__(self, step_fn: Callable[[Any, Any], Tuple[Any, Any]], *,
+                 checkpointer: Optional[ResilientCheckpointer] = None,
+                 checkpoint_every: int = 100,
+                 async_checkpoints: bool = True,
+                 finite_of: Optional[Callable[[Any], Any]] = None,
+                 scalars_of: Optional[Callable[[Any], Dict[str, Any]]] = None,
+                 nan_tolerance: int = 3,
+                 max_rewinds: int = 2,
+                 watchdog: Optional[WatchdogConfig] = None,
+                 metrics: Optional[MetricsWriter] = None,
+                 preempt_signals: Tuple[int, ...] = (signal.SIGTERM,)):
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        if nan_tolerance < 1:
+            raise ValueError(
+                f"nan_tolerance must be >= 1, got {nan_tolerance}")
+        if max_rewinds < 0:
+            raise ValueError(
+                f"max_rewinds must be >= 0, got {max_rewinds}")
+        self.step_fn = step_fn
+        self.checkpointer = checkpointer
+        self.checkpoint_every = int(checkpoint_every)
+        self.async_checkpoints = bool(async_checkpoints)
+        self.finite_of = finite_of
+        self.scalars_of = scalars_of
+        self.nan_tolerance = int(nan_tolerance)
+        self.max_rewinds = int(max_rewinds)
+        self.watchdog_cfg = watchdog
+        self.metrics = metrics
+        self.preempt_signals = tuple(preempt_signals)
+        self._preempt_requested = threading.Event()
+
+    # ---------------------------------------------------------- signals
+    def request_preemption(self) -> None:
+        """Programmatic preemption: checkpoint + clean exit at the next
+        step boundary (what the signal handler calls)."""
+        self._preempt_requested.set()
+
+    def _install_handlers(self) -> Dict[int, Any]:
+        previous: Dict[int, Any] = {}
+        for sig in self.preempt_signals:
+            try:
+                previous[sig] = signal.signal(
+                    sig, lambda _s, _f: self.request_preemption())
+            except ValueError:          # not the main thread
+                break
+        return previous
+
+    # -------------------------------------------------------------- run
+    def run(self, carry: Any, data_fn: Callable[[int], Any],
+            num_steps: int) -> Tuple[Any, LoopReport]:
+        """Train to ``num_steps`` total steps (absolute, so a resumed
+        run picks up where the checkpoint left off).
+
+        ``data_fn(step) -> batch`` must be a function of the step
+        index — that is what makes preemption/rewind replay land on
+        the same trajectory as an uninterrupted run.  Returns the
+        final carry and a :class:`LoopReport`.
+        """
+        report = LoopReport()
+        self._preempt_requested.clear()
+        if self.checkpointer is not None:
+            hit = self.checkpointer.restore_latest(carry)
+            if hit is not None:
+                report.resumed_from, carry = hit
+        cursor = report.resumed_from or 0
+        report.start_step = cursor
+        previous_handlers = self._install_handlers()
+        dog = _Watchdog(self.watchdog_cfg) if self.watchdog_cfg else None
+        consecutive_nonfinite = 0
+        saved_at = report.resumed_from
+        try:
+            while cursor < num_steps:
+                try:
+                    faults.inject("train.step", step=cursor)
+                except faults.Preempted:
+                    self.request_preemption()
+                if self._preempt_requested.is_set():
+                    if consecutive_nonfinite == 0:
+                        self._final_save(cursor, carry, report,
+                                         saved_at)
+                    report.preempted = True
+                    counters.inc("train.preempted")
+                    break
+                t0 = time.monotonic()
+                if dog is not None:
+                    dog.arm(cursor)
+                advisories = faults.inject("train.compute", step=cursor)
+                if any(a.kind == "nan" for a in advisories):
+                    carry = _poison_nan(carry)
+                carry, aux = self.step_fn(carry, data_fn(cursor))
+                if dog is not None:
+                    aux = jax.block_until_ready(aux)
+                    dog.disarm(time.monotonic() - t0)
+                    if dog.fired_step is not None:
+                        report.watchdog_fired = True
+                        raise WatchdogTimeout(
+                            f"step {dog.fired_step} exceeded the "
+                            f"watchdog deadline; stacks dumped to "
+                            f"{self.watchdog_cfg.dump_path or 'stderr'}")
+                cursor += 1
+                report.steps_run += 1
+                self._emit(cursor, t0, aux, report)
+                finite = self._finite(aux)
+                if finite is False:
+                    consecutive_nonfinite += 1
+                    report.nonfinite_steps += 1
+                    if consecutive_nonfinite >= self.nan_tolerance:
+                        cursor, carry = self._escalate(
+                            cursor, carry, report)
+                        consecutive_nonfinite = 0
+                        continue
+                else:
+                    consecutive_nonfinite = 0
+                # never checkpoint mid-NaN-burst: a non-finite step
+                # below nan_tolerance must not become the "last good"
+                # checkpoint the rewind rung restores
+                if self.checkpointer is not None \
+                        and consecutive_nonfinite == 0 \
+                        and cursor % self.checkpoint_every == 0:
+                    self.checkpointer.save(
+                        cursor, carry,
+                        blocking=not self.async_checkpoints)
+                    report.checkpoints_saved += 1
+                    saved_at = cursor
+            else:
+                if consecutive_nonfinite == 0:
+                    self._final_save(cursor, carry, report, saved_at)
+        finally:
+            if dog is not None:
+                dog.stop()
+            for sig, handler in previous_handlers.items():
+                signal.signal(sig, handler)
+            if self.checkpointer is not None:
+                self.checkpointer.wait()
+        report.final_step = cursor
+        report.diagnostics.setdefault("counters", counters.snapshot())
+        return carry, report
+
+    # ---------------------------------------------------------- helpers
+    def _finite(self, aux: Any) -> Optional[bool]:
+        if self.finite_of is None:
+            return None
+        flag = self.finite_of(aux)
+        return None if flag is None else bool(flag)
+
+    def _emit(self, step: int, t0: float, aux: Any,
+              report: LoopReport) -> None:
+        if self.metrics is None:
+            return
+        row = {"step_seconds": time.monotonic() - t0,
+               "rewinds": report.rewinds}
+        if self.scalars_of is not None:
+            row.update({k: float(v)
+                        for k, v in self.scalars_of(aux).items()})
+        self.metrics(step, row)
+        self.metrics.drain()
+
+    def _final_save(self, cursor: int, carry: Any, report: LoopReport,
+                    saved_at: Optional[int]) -> None:
+        if self.checkpointer is None or cursor == saved_at:
+            return
+        self.checkpointer.save(cursor, carry, blocking=True)
+        report.checkpoints_saved += 1
+
+    def _divergence_diag(self, cursor: int, carry: Any,
+                         report: LoopReport) -> Dict[str, Any]:
+        diag: Dict[str, Any] = {
+            "step": cursor,
+            "rewinds": report.rewinds,
+            "nonfinite_steps": report.nonfinite_steps,
+            "nan_tolerance": self.nan_tolerance,
+            "counters": counters.snapshot(),
+        }
+        scaler = getattr(carry, "loss_scaler", None)
+        ls_state = getattr(carry, "loss_scale_state", None)
+        if scaler is not None and ls_state is not None:
+            try:
+                diag["loss_scale"] = float(
+                    jax.device_get(ls_state.loss_scale))
+                diag["loss_scale_backoff_exhausted"] = bool(
+                    jax.device_get(scaler.backoff_exhausted(ls_state)))
+            except Exception:                         # noqa: BLE001
+                pass
+        return diag
+
+    def _escalate(self, cursor: int, carry: Any,
+                  report: LoopReport) -> Tuple[int, Any]:
+        """Rung 2/3 of the ladder: rewind to the last good checkpoint,
+        or abort with the divergence diagnostic."""
+        report.rewinds += 1
+        counters.inc("train.rewind")
+        diag = self._divergence_diag(cursor, carry, report)
+        hit = None
+        if report.rewinds <= self.max_rewinds \
+                and self.checkpointer is not None:
+            hit = self.checkpointer.restore_latest(carry)
+        if hit is None:
+            report.diagnostics.update(diag)
+            reason = ("no valid checkpoint to rewind to"
+                      if report.rewinds <= self.max_rewinds
+                      else f"rewind budget exhausted "
+                           f"({self.max_rewinds})")
+            raise DivergenceError(
+                f"{self.nan_tolerance} consecutive non-finite steps at "
+                f"step {cursor} and {reason}; diagnostics: {diag}",
+                report)
+        step, restored = hit
+        jnp.zeros(()).block_until_ready()     # flush pending dispatch
+        return step, restored

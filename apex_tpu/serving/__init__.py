@@ -14,6 +14,7 @@ through the engine is token-identical to
 
 from apex_tpu.serving.api import (
     InferenceServer,
+    RequestFailed,
     RequestHandle,
     ServerClosed,
 )
@@ -28,6 +29,7 @@ from apex_tpu.serving.scheduler import (
 __all__ = [
     "InferenceServer",
     "RequestHandle",
+    "RequestFailed",
     "ServerClosed",
     "Engine",
     "DEFAULT_BUCKETS",

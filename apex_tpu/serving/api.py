@@ -23,31 +23,57 @@ from __future__ import annotations
 import queue as queue_mod
 import threading
 import time
-from typing import List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from apex_tpu.resilience import faults
 from apex_tpu.serving.engine import DEFAULT_BUCKETS, Engine
 from apex_tpu.serving.scheduler import QueueFull, Request, Scheduler
-from apex_tpu.utils.metrics import MetricsWriter
+from apex_tpu.utils.metrics import MetricsWriter, counters
 
-__all__ = ["InferenceServer", "RequestHandle", "ServerClosed"]
+__all__ = ["InferenceServer", "RequestHandle", "ServerClosed",
+           "RequestFailed"]
 
 _SENTINEL = object()
 
 
 class ServerClosed(RuntimeError):
-    """Submit after shutdown, or a request cancelled by shutdown."""
+    """TERMINAL: the server shut down (or its worker died) before the
+    request finished — the request will never produce more tokens.
+    Also raised by ``submit`` on a stopped server."""
+
+
+class RequestFailed(RuntimeError):
+    """TERMINAL: this one request failed — deadline expired, repeated
+    step faults, or an unresumable continuation — while the server
+    itself keeps serving.  ``__cause__`` carries the root failure when
+    there is one."""
 
 
 class RequestHandle:
-    """Client-side view of one in-flight request."""
+    """Client-side view of one in-flight request.
+
+    Error contract (see ``docs/resilience.md``): :meth:`stream` and
+    :meth:`result` raise exactly one of
+
+    - ``TimeoutError`` — RETRYABLE: *no token yet* within ``timeout``.
+      The request is still live; call again with the same handle.
+    - :class:`RequestFailed` — TERMINAL: this request failed (deadline,
+      repeated faults); the server is still serving others.
+    - :class:`ServerClosed` — TERMINAL: the server stopped first.
+
+    The terminal error is recorded on the handle *before* clients are
+    woken, so a shutdown can never surface as a bare timeout: a reader
+    either times out (and may retry) or observes the real terminal
+    state — never a timeout that silently means "cancelled".
+    """
 
     def __init__(self, request: Request):
         self._request = request
         self._stream: "queue_mod.Queue" = queue_mod.Queue()
         self._done = threading.Event()
-        self._cancelled = False
+        self._error: Optional[BaseException] = None
 
     # ------------------------------------------------------- server side
     def _deliver(self, token: int, finished: bool) -> None:
@@ -56,41 +82,58 @@ class RequestHandle:
             self._stream.put(_SENTINEL)
             self._done.set()
 
-    def _cancel(self) -> None:
-        self._cancelled = True
+    def _fail(self, error: BaseException) -> None:
+        """Terminal failure: record the cause, then wake clients."""
+        self._error = error
         self._stream.put(_SENTINEL)
         self._done.set()
+
+    def _cancel(self) -> None:
+        self._fail(ServerClosed(
+            "server shut down before the request finished"))
 
     # ------------------------------------------------------- client side
     def stream(self, timeout: Optional[float] = None):
         """Yield tokens as they are produced; ends at eos/budget.
-        Raises :class:`ServerClosed` if the server shut down first,
-        ``TimeoutError`` if no token arrives within ``timeout``."""
+
+        ``TimeoutError`` means *no token yet* — retryable, resume with
+        another ``stream()``/``result()`` call; :class:`RequestFailed`
+        and :class:`ServerClosed` are terminal (class docstring has the
+        full contract).
+        """
         while True:
             try:
                 item = self._stream.get(timeout=timeout)
             except queue_mod.Empty:
                 raise TimeoutError(
-                    f"no token within {timeout}s") from None
+                    f"no token within {timeout}s (request still "
+                    f"live — retryable)") from None
             if item is _SENTINEL:
-                if self._cancelled:
-                    raise ServerClosed(
-                        "server shut down before the request finished")
+                if self._error is not None:
+                    raise self._error
                 return
             yield item
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
-        """Block until finished; returns every produced token."""
+        """Block until finished; returns every produced token.  Same
+        error contract as :meth:`stream`: ``TimeoutError`` is
+        retryable ("still decoding"), :class:`RequestFailed` /
+        :class:`ServerClosed` are terminal."""
         if not self._done.wait(timeout):
-            raise TimeoutError("request still decoding")
-        if self._cancelled:
-            raise ServerClosed(
-                "server shut down before the request finished")
+            raise TimeoutError(
+                "request still decoding (retryable)")
+        if self._error is not None:
+            raise self._error
         return list(self._request.tokens)
 
     @property
     def done(self) -> bool:
         return self._done.is_set()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The terminal error, or ``None`` (also ``None`` while live)."""
+        return self._error
 
     @property
     def tokens_so_far(self) -> List[int]:
@@ -105,6 +148,20 @@ class InferenceServer:
     ``shutdown(wait=True)`` serves everything already accepted, then
     stops; ``wait=False`` cancels queued AND in-flight requests (their
     handles raise :class:`ServerClosed`).
+
+    Failure semantics (docs/resilience.md): a retryable
+    :class:`~apex_tpu.resilience.faults.TransientError` during a step
+    poisons only the slots it names (all active slots when it names
+    none) — those tenants are evicted and requeued ONCE, continuing
+    from their already-streamed prefix; a second fault (or an
+    unresumable continuation) fails just that request with
+    :class:`RequestFailed`.  Per-request deadlines are enforced both in
+    the queue and mid-decode.  Every accepted request therefore ends in
+    exactly one of: tokens delivered to completion, ``RequestFailed``,
+    or ``ServerClosed`` — never silently lost, never hung.  Anything
+    non-transient still kills the worker and cancels all clients (the
+    engine's device state cannot be trusted after an arbitrary
+    failure).
     """
 
     def __init__(self, model, params, *, max_slots: int = 4,
@@ -125,10 +182,14 @@ class InferenceServer:
         self._drain_on_stop = True
         self._thread: Optional[threading.Thread] = None
         self._steps = 0
+        self._step_attempts = 0
         self._tokens_emitted = 0
         self._window_tokens = 0
         self._window_t0: Optional[float] = None
         self._last_emit_step = -1
+        self._requeues = 0
+        self._failed_requests = 0
+        self._deadline_expired = 0
         #: the exception that killed the worker loop, if any — clients
         #: see ServerClosed; the root cause lives here for post-mortems
         self.error: Optional[BaseException] = None
@@ -167,14 +228,23 @@ class InferenceServer:
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None,
                eos_id: Optional[int] = None, seed: int = 0,
+               deadline: Optional[float] = None,
                block: bool = True,
                timeout: Optional[float] = None) -> RequestHandle:
-        """Enqueue one request; returns its :class:`RequestHandle`."""
+        """Enqueue one request; returns its :class:`RequestHandle`.
+
+        ``deadline`` (seconds from acceptance) bounds the request's
+        total latency: once expired — whether still queued or
+        mid-decode — it fails with :class:`RequestFailed` and its slot
+        is freed.  ``timeout`` bounds only this *submission* under
+        backpressure (distinct from the deadline).
+        """
         request = Request(
             prompt=np.asarray(prompt, np.int32).reshape(-1),
             max_new_tokens=int(max_new_tokens),
             temperature=float(temperature),
-            top_k=top_k, top_p=top_p, eos_id=eos_id, seed=int(seed))
+            top_k=top_k, top_p=top_p, eos_id=eos_id, seed=int(seed),
+            deadline=None if deadline is None else float(deadline))
         # the handle must be reachable by the worker BEFORE the request
         # enters the queue: run_step doesn't take _wakeup, so a fast
         # worker can admit — even finish — a one-token request between
@@ -183,7 +253,10 @@ class InferenceServer:
         # uid is only assigned inside scheduler.submit).
         handle = RequestHandle(request)
         self._handles[id(request)] = handle
-        deadline = None if timeout is None else time.monotonic() + timeout
+        # distinct from the per-request `deadline`: this bounds only
+        # the backpressure wait of THIS submit call
+        submit_deadline = None if timeout is None \
+            else time.monotonic() + timeout
         try:
             while True:
                 with self._wakeup:
@@ -196,8 +269,8 @@ class InferenceServer:
                     except QueueFull:
                         if not block:
                             raise
-                        remaining = None if deadline is None \
-                            else deadline - time.monotonic()
+                        remaining = None if submit_deadline is None \
+                            else submit_deadline - time.monotonic()
                         if remaining is not None and remaining <= 0:
                             raise
                         # woken by the worker after each admission wave
@@ -219,7 +292,33 @@ class InferenceServer:
                     if self._stop and (not self._drain_on_stop
                                        or not self.scheduler.has_work()):
                         break
-                events = self.scheduler.run_step()
+                self._expire_deadlines()
+                if not self.scheduler.has_work():
+                    continue                # everything just expired
+                try:
+                    # injected against the ATTEMPT counter, not
+                    # self._steps: a faulted attempt doesn't advance
+                    # the step count, and a step-pinned fault keyed on
+                    # it would re-fire forever and starve recovery
+                    attempt = self._step_attempts
+                    self._step_attempts += 1
+                    faults.inject("serving.step", step=attempt)
+                    events = self.scheduler.run_step()
+                except faults.TransientError as exc:
+                    # a retryable step fault: the raiser guarantees
+                    # engine state is intact (host-side failure, raised
+                    # before dispatch), so recovery is slot-local —
+                    # evict the poisoned tenants, requeue each once
+                    self._recover_step(exc)
+                    with self._wakeup:
+                        self._wakeup.notify_all()
+                    continue
+                for req, exc in self.scheduler.take_admit_failures():
+                    failure = RequestFailed(
+                        f"admission failed twice for request "
+                        f"{req.uid}: {exc}")
+                    failure.__cause__ = exc
+                    self._fail_request(req, failure)
                 self._steps += 1
                 now = time.monotonic()
                 if self._window_t0 is None:
@@ -266,6 +365,69 @@ class InferenceServer:
                     and self._steps != self._last_emit_step:
                 self._emit_metrics(time.monotonic())
 
+    # ----------------------------------------------------- fault recovery
+    def _fail_request(self, req: Request,
+                      failure: RequestFailed) -> None:
+        """Route a terminal per-request failure to its handle."""
+        self._failed_requests += 1
+        counters.inc("serving.request_failed")
+        handle = self._handles.pop(id(req), None)
+        if handle is not None:
+            handle._fail(failure)
+
+    def _recover_step(self, exc: "faults.TransientError") -> None:
+        """Evict the poisoned slots; requeue each tenant once.
+
+        ``exc.slots`` names the poisoned slots when attribution exists;
+        with none, every active slot is suspect (the fault fired before
+        any of them stepped).  A tenant already requeued once — or one
+        whose continuation no longer fits a bucket — fails terminally
+        with :class:`RequestFailed`; the server itself keeps serving.
+        """
+        counters.inc("serving.step_fault")
+        poisoned = getattr(exc, "slots", None)
+        for slot, req in enumerate(list(self.scheduler._slots)):
+            if req is None:
+                continue
+            if poisoned is not None and slot not in poisoned:
+                continue
+            self.scheduler.evict(slot)
+            cause: BaseException = exc
+            if req.retries < 1:
+                req.retries += 1
+                try:
+                    self.scheduler.requeue(req)
+                    self._requeues += 1
+                    counters.inc("serving.requeue")
+                    continue
+                except ValueError as ve:    # unresumable continuation
+                    cause = ve
+            failure = RequestFailed(
+                f"request {req.uid} evicted by a step fault and not "
+                f"requeueable (retries={req.retries}): {cause}")
+            failure.__cause__ = cause
+            self._fail_request(req, failure)
+
+    def _expire_deadlines(self) -> None:
+        """Fail queued AND in-flight requests past their deadline."""
+        now = time.monotonic()
+        for req in self.scheduler.expire_queued(now):
+            self._deadline_expired += 1
+            counters.inc("serving.deadline_expired")
+            self._fail_request(req, RequestFailed(
+                f"request {req.uid} deadline ({req.deadline}s) "
+                f"expired in queue"))
+        for slot, req in enumerate(list(self.scheduler._slots)):
+            if req is None or req.deadline is None:
+                continue
+            if now - req.accepted_at > req.deadline:
+                self.scheduler.evict(slot)
+                self._deadline_expired += 1
+                counters.inc("serving.deadline_expired")
+                self._fail_request(req, RequestFailed(
+                    f"request {req.uid} deadline ({req.deadline}s) "
+                    f"expired after {len(req.tokens)} tokens"))
+
     def _emit_metrics(self, now: float) -> None:
         dt = max(now - (self._window_t0 or now), 1e-9)
         self.metrics(self._steps, {
@@ -273,11 +435,48 @@ class InferenceServer:
             "occupancy": self.scheduler.occupancy,
             "queue_depth": self.scheduler.queue_depth,
             "tokens_total": self._tokens_emitted,
+            "requeues": self._requeues,
+            "failed_requests": self._failed_requests,
+            "deadline_expired": self._deadline_expired,
         })
         self.metrics.drain()
         self._last_emit_step = self._steps
         self._window_tokens = 0
         self._window_t0 = now
+
+    # ------------------------------------------------------------- health
+    def health(self) -> Dict[str, Any]:
+        """Readiness/liveness probe (cheap; any thread).
+
+        ``status`` is ``"serving"`` (worker alive, accepting),
+        ``"stopped"`` (never started, shut down, or draining out), or
+        ``"failed"`` (worker died — root cause in ``error``);
+        ``ready`` is the single boolean a load balancer should gate
+        on.  Counter fields make the probe double as the chaos-soak
+        scoreboard: accepted == completed + failed when nothing is
+        lost.
+        """
+        with self._wakeup:
+            alive = self._thread is not None and self._thread.is_alive()
+            stopping = self._stop
+        if self.error is not None:
+            status = "failed"
+        elif not alive or stopping:
+            status = "stopped"
+        else:
+            status = "serving"
+        return {
+            "status": status,
+            "ready": status == "serving",
+            "steps": self._steps,
+            "queue_depth": self.scheduler.queue_depth,
+            "occupancy": self.scheduler.occupancy,
+            "tokens_emitted": self._tokens_emitted,
+            "requeues": self._requeues,
+            "failed_requests": self._failed_requests,
+            "deadline_expired": self._deadline_expired,
+            "error": None if self.error is None else repr(self.error),
+        }
 
     # ---------------------------------------------------------- telemetry
     @property

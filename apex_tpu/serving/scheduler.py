@@ -21,10 +21,14 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+import time
 from collections import deque
 from typing import Deque, List, Optional, Tuple
 
 import numpy as np
+
+from apex_tpu.resilience import faults
+from apex_tpu.utils.metrics import counters
 
 __all__ = ["Request", "Scheduler", "QueueFull", "StepEvent"]
 
@@ -41,6 +45,14 @@ class Request:
     disables the nucleus filter, ``eos_id=None`` disables eos
     stopping, ``seed`` derives the request's private sampling key
     (tokens are a function of the request, not of its co-tenants).
+    ``deadline`` (seconds from acceptance, ``None`` = unbounded) is
+    enforced by the serving loop: an expired request — queued or
+    mid-decode — fails with an explicit terminal error rather than
+    occupying a slot forever.
+
+    ``retries`` / ``accepted_at`` are serving-loop bookkeeping: how
+    many times this request has been requeued after a transient step
+    fault, and when it entered the queue (the deadline epoch).
     """
 
     prompt: np.ndarray
@@ -50,8 +62,11 @@ class Request:
     top_p: Optional[float] = None
     eos_id: Optional[int] = None
     seed: int = 0
+    deadline: Optional[float] = None
     uid: int = -1                       # assigned by the scheduler
     tokens: List[int] = dataclasses.field(default_factory=list)
+    retries: int = 0
+    accepted_at: float = -1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +94,7 @@ class Scheduler:
         # host shadow of slot occupancy — the device active mask is
         # never read back outside step()
         self._slots: List[Optional[Request]] = [None] * engine.max_slots
+        self._admit_failures: List[Tuple[Request, BaseException]] = []
 
     # ------------------------------------------------------------ intake
     def submit(self, request: Request) -> Request:
@@ -91,14 +107,63 @@ class Scheduler:
             prompt.shape[0], request.max_new_tokens,
             request.temperature, request.top_k, request.top_p)
         request.prompt = prompt
+        # originals, for fault-recovery requeues: a requeued request is
+        # re-admitted with prompt = original ++ tokens-so-far and the
+        # remaining budget, both derived from these
+        request._prompt0 = prompt                    # type: ignore[attr-defined]
+        request._budget0 = int(request.max_new_tokens)  # type: ignore[attr-defined]
         with self._lock:
             if len(self._queue) >= self.queue_capacity:
                 raise QueueFull(
                     f"request queue at capacity "
                     f"({self.queue_capacity}); retry after a drain")
             request.uid = next(self._uid)
+            request.accepted_at = time.monotonic()
             self._queue.append(request)
         return request
+
+    def requeue(self, request: Request) -> None:
+        """Put an already-ACCEPTED request back at the queue's front
+        (fault-recovery path — see ``InferenceServer._serve``).
+
+        The request continues where it left off: its next admission
+        prefills ``original prompt ++ tokens emitted so far`` with the
+        remaining budget, so clients keep their streamed prefix and the
+        total token count is unchanged.  Validates the continuation
+        (the longer prompt must still fit a bucket) — a ``ValueError``
+        here means the request cannot be resumed and the caller must
+        fail it terminally.  Bypasses the capacity check: accepted
+        requests are never dropped for queue pressure.
+        """
+        prompt = np.asarray(request._prompt0, np.int32)  # type: ignore[attr-defined]
+        if request.tokens:
+            prompt = np.concatenate(
+                [prompt, np.asarray(request.tokens, np.int32)])
+        budget = int(request._budget0) - len(request.tokens)  # type: ignore[attr-defined]
+        self.engine.validate_request(
+            prompt.shape[0], budget, request.temperature,
+            request.top_k, request.top_p)
+        request.prompt = prompt
+        request.max_new_tokens = budget
+        with self._lock:
+            self._queue.appendleft(request)
+
+    def expire_queued(self, now: Optional[float] = None) -> List[Request]:
+        """Remove and return queued requests whose deadline has passed
+        (in-flight expiry is the serving loop's job — it owns the
+        engine slots)."""
+        now = time.monotonic() if now is None else now
+        expired: List[Request] = []
+        with self._lock:
+            keep: Deque[Request] = deque()
+            for req in self._queue:
+                if req.deadline is not None \
+                        and now - req.accepted_at > req.deadline:
+                    expired.append(req)
+                else:
+                    keep.append(req)
+            self._queue = keep
+        return expired
 
     @property
     def queue_depth(self) -> int:
@@ -118,7 +183,16 @@ class Scheduler:
 
     # ------------------------------------------------------------- steps
     def _admit_from_queue(self) -> int:
-        """Fill free slots FIFO; returns the number admitted."""
+        """Fill free slots FIFO; returns the number admitted.
+
+        A TRANSIENT failure during one admission (a retryable
+        :class:`~apex_tpu.resilience.faults.TransientError`, injected
+        or real — the raiser's contract is that engine state is
+        untouched) is isolated to that request: it is retried from the
+        queue's front once, then recorded terminally on
+        ``take_admit_failures`` — either way the other tenants keep
+        decoding.  Any other exception propagates (fatal, as before).
+        """
         admitted = 0
         for slot, occupant in enumerate(self._slots):
             if occupant is not None:
@@ -127,17 +201,47 @@ class Scheduler:
                 if not self._queue:
                     break
                 req = self._queue.popleft()
-            self.engine.admit(
-                slot, req.prompt,
-                max_new_tokens=req.max_new_tokens,
-                temperature=req.temperature,
-                top_k=req.top_k or 0,
-                top_p=req.top_p,
-                eos_id=req.eos_id,
-                seed=req.seed)
+            try:
+                faults.inject("serving.admit")
+                self.engine.admit(
+                    slot, req.prompt,
+                    max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature,
+                    top_k=req.top_k or 0,
+                    top_p=req.top_p,
+                    eos_id=req.eos_id,
+                    seed=req.seed)
+            except faults.TransientError as exc:
+                counters.inc("serving.admit_fault")
+                if req.retries < 1:
+                    req.retries += 1
+                    with self._lock:
+                        self._queue.appendleft(req)
+                else:
+                    self._admit_failures.append((req, exc))
+                # don't spin on the same request within one boundary —
+                # the retry happens at the next step
+                break
             self._slots[slot] = req
             admitted += 1
         return admitted
+
+    def take_admit_failures(self) -> List[Tuple[Request, BaseException]]:
+        """Drain requests whose admission failed terminally (the
+        serving loop routes these to their handles)."""
+        failed, self._admit_failures = self._admit_failures, []
+        return failed
+
+    def evict(self, slot: int) -> Optional[Request]:
+        """Release ``slot`` (zero the engine row) and return its
+        tenant — deadline-expiry and fault-recovery path.  Call from
+        the engine-owning thread only."""
+        req = self._slots[slot]
+        if req is None:
+            return None
+        self.engine.release(slot)
+        self._slots[slot] = None
+        return req
 
     def run_step(self) -> List[StepEvent]:
         """One step boundary: admit → decode → route/evict.

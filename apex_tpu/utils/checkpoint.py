@@ -36,17 +36,55 @@ def save_checkpoint(path: str, state: Any, *, force: bool = False) -> None:
     checkpoint API is data loss, not convenience.  Pass ``force=True``
     to overwrite deliberately (e.g. a rolling "latest" path).
 
+    Every save stages to a sibling temp directory and moves into place
+    with ``os.rename``, so a crash (or an injected I/O fault — the
+    ``"checkpoint.write"`` site of :mod:`apex_tpu.resilience.faults`)
+    at any point during the write can never destroy an existing
+    checkpoint at ``path``: ``force=True`` used to hand the path
+    straight to the writer, and dying mid-write clobbered the previous
+    "latest".  The only non-atomic instant is the two-rename swap of
+    an overwrite; a crash exactly between them leaves the old
+    checkpoint intact at ``<path>.prev-<pid>`` and the complete new
+    one at ``<path>.stage-<pid>`` — recoverable by renaming either
+    into place (:class:`apex_tpu.resilience.ResilientCheckpointer`
+    closes even that window with per-step directories + manifests).
+
     Blocks until the write completes (orbax's async machinery still
     overlaps the device→host copies).
     """
+    import shutil
+
     path = os.path.abspath(path)
     if not force and os.path.exists(path):
         raise FileExistsError(
             f"checkpoint path {path!r} already exists — refusing to "
             f"overwrite; pass force=True to clobber it deliberately")
+    # lazy import: resilience layers on this module, not vice versa
+    from apex_tpu.resilience import faults
+
+    stage = f"{path}.stage-{os.getpid()}"
+    prev = f"{path}.prev-{os.getpid()}"
+    shutil.rmtree(stage, ignore_errors=True)      # stale crash debris
     ckptr = _checkpointer()
-    ckptr.save(path, state, force=force)
-    ckptr.wait_until_finished()
+    try:
+        faults.inject("checkpoint.write")
+        ckptr.save(stage, state)
+        ckptr.wait_until_finished()
+        if os.path.exists(path):
+            shutil.rmtree(prev, ignore_errors=True)
+            os.rename(path, prev)
+            os.rename(stage, path)
+            shutil.rmtree(prev, ignore_errors=True)
+        else:
+            os.rename(stage, path)
+    except BaseException:
+        # cleanup must never leave NOTHING at `path`: if the swap got
+        # as far as parking the old checkpoint at `prev`, roll it back
+        # before discarding the stage
+        if os.path.exists(prev) and not os.path.exists(path):
+            os.rename(prev, path)
+        shutil.rmtree(stage, ignore_errors=True)
+        raise
 
 
 def restore_checkpoint(path: str, target: Any) -> Any:

@@ -24,9 +24,54 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
-__all__ = ["MetricsWriter", "log_metrics"]
+__all__ = ["MetricsWriter", "log_metrics", "Counters", "counters"]
 
 _logger = logging.getLogger("apex_tpu.metrics")
+
+
+class Counters:
+    """Thread-safe named monotone counters (fault firings, data-source
+    retries, checkpoint restores, serving requeues, ...).
+
+    Deliberately simpler than :class:`MetricsWriter`: counters have no
+    step axis — they count *events*, not per-step scalars — and are
+    read by health probes and post-mortem reports
+    (``server.health()``, ``LoopReport``), not drained to a sink.
+    ``snapshot()`` returns a plain dict so a caller can diff
+    before/after an operation.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> int:
+        """Add ``n`` to ``name`` (created at 0); returns the new value."""
+        with self._lock:
+            value = self._counts.get(name, 0) + int(n)
+            self._counts[name] = value
+            return value
+
+    def get(self, name: str) -> int:
+        """Current value of ``name`` (0 if never incremented)."""
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        """Copy of every counter, for diffing or report embedding."""
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        """Zero everything (test isolation)."""
+        with self._lock:
+            self._counts.clear()
+
+
+#: process-wide default counter set — the resilience layer's event
+#: counters (``fault.*``, ``checkpoint.*``, ``serving.*``, ``data.*``)
+#: land here unless a component is handed its own :class:`Counters`.
+counters = Counters()
 
 
 class MetricsWriter:

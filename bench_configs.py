@@ -26,6 +26,8 @@ Legs (reference workloads per BASELINE.json):
                      bytes/token roofline, blocked-vs-einsum A/B
   serving_decode     continuous-batching engine tokens/s at fixed
                      occupancy vs single-stream generate() baseline
+  resilience_overhead  ResilientLoop + async rolling checkpoints vs
+                     the bare train loop (target <2% at ckpt-every-100)
   vit_huge_lamb      ViT-H/14, amp O2 + FusedLAMB           (configs[4])
   long_context       8k/16k/32k/32k-windowed ladder, phase-sum bounds
   group_norm         GN+SiLU fwd+bwd achieved GB/s
@@ -2058,6 +2060,136 @@ def bench_group_norm():
     })
 
 
+# ------------------------------------------------------------- resilience
+
+def bench_resilience_overhead():
+    """Steady-state cost of the resilience wrapper (ISSUE 4): the SAME
+    jitted train step driven by the bare python loop vs
+    ``ResilientLoop`` with async rolling hash-manifest checkpoints
+    every ``BENCH_RESIL_CKPT_EVERY`` steps.  Target: <2% step-time
+    overhead at checkpoint-every-100 — per step the wrapper adds two
+    no-plan fault-injection checks, a ``time.monotonic`` pair and a
+    preemption-flag read; the checkpoint's device_get+hash+write rides
+    a background thread and amortizes across the interval.  The step
+    count is sized so the run ends ON a checkpoint boundary (the final
+    blocking save is skipped as already-saved, keeping the measurement
+    steady-state).
+
+    Env: BENCH_RESIL_STEPS (300), BENCH_RESIL_CKPT_EVERY (100)."""
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.models import gpt_loss_fn
+    from apex_tpu.optim import fused_adam
+    from apex_tpu.resilience import ResilientCheckpointer, ResilientLoop
+    from apex_tpu.transformer.testing import standalone_gpt
+
+    steps = int(os.environ.get("BENCH_RESIL_STEPS", "300"))
+    every = int(os.environ.get("BENCH_RESIL_CKPT_EVERY", "100"))
+    steps = max(every, steps - steps % every)   # end ON a ckpt boundary
+    b, s = 8, 32
+    model, init_params = standalone_gpt(seed=0, max_seq_len=s)
+    vocab = model.cfg.vocab_size
+    ids = jax.random.randint(jax.random.PRNGKey(7), (4, b, s + 1), 0,
+                             vocab, jnp.int32)
+
+    def make_state():
+        # fresh buffers per run: the donated step would otherwise
+        # delete the shared init_params out from under the next run
+        fresh = jax.tree.map(jnp.array, init_params)
+        return amp.initialize(
+            model.apply, {"params": fresh}, fused_adam(3e-4),
+            opt_level="O2", half_dtype=jnp.bfloat16)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, chunk):
+        inputs, labels = chunk[:, :-1], chunk[:, 1:]
+
+        def loss_fn(p):
+            cp = state.policy.cast_to_compute(p)
+            logits = state.apply_fn(cp, inputs)
+            loss = gpt_loss_fn(logits.astype(jnp.float32), labels)
+            return state.scale_loss(loss), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
+        new_state, _finite = state.apply_gradients(grads=grads)
+        return new_state, loss
+
+    def data_fn(i):
+        return ids[i % 4]
+
+    # shared warmup: one compile serves both loops (same jit object)
+    warm, _ = step(make_state(), ids[0])
+    jax.block_until_ready(warm.params)
+    del warm
+
+    def bare():
+        state = make_state()
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, loss = step(state, data_fn(i))
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0) / steps
+
+    def loop_step(st, batch):
+        st, loss = step(st, batch)
+        return st, {"loss": loss}
+
+    def resilient():
+        ckpt_dir = tempfile.mkdtemp(prefix="apex_tpu_resil_bench_")
+        loop = ResilientLoop(
+            loop_step,
+            checkpointer=ResilientCheckpointer(ckpt_dir, keep=2),
+            checkpoint_every=every, async_checkpoints=True)
+        try:
+            t0 = time.perf_counter()
+            carry, report = loop.run(make_state(), data_fn, steps)
+            jax.block_until_ready(carry.params)
+            dt = (time.perf_counter() - t0) / steps
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+        return dt, report
+
+    k_windows = max(1, int(os.environ.get("BENCH_WINDOWS", "3")))
+    bare_dt = min(bare() for _ in range(k_windows))
+    pairs = [resilient() for _ in range(k_windows)]
+    resil_dt = min(dt for dt, _ in pairs)
+    report = pairs[0][1]
+    overhead = resil_dt / bare_dt - 1.0
+    n_ckpts = max(1, report.checkpoints_saved)
+    _emit({
+        "metric": f"resilience_overhead_ckpt{every}_pct",
+        "value": round(100.0 * overhead, 2),
+        "unit": "percent step-time overhead (ResilientLoop + async "
+                "rolling checkpoints vs bare loop)",
+        "bare_step_ms": round(bare_dt * 1e3, 3),
+        "resilient_step_ms": round(resil_dt * 1e3, 3),
+        "ms_per_checkpoint": round(
+            (resil_dt - bare_dt) * steps * 1e3 / n_ckpts, 1),
+        "steps": steps,
+        "checkpoint_every": every,
+        "checkpoints_written": report.checkpoints_saved,
+        "target_pct": 2.0,
+        "meets_target": bool(overhead < 0.02),
+        "note": ("same jitted step both rows, shared compile, best of "
+                 f"{k_windows} runs each; run ends on a checkpoint "
+                 "boundary so the final blocking save is amortized "
+                 "out (steady state, not save latency).  On the CPU "
+                 "backend this is an UPPER bound: the async snapshot "
+                 "copy and the background hash/serialize thread share "
+                 "the step's own cores, whereas on TPU the step runs "
+                 "on-device and only the (μs-scale) on-device copy "
+                 "lands in the step's critical path — "
+                 "ms_per_checkpoint / (checkpoint_every × step_ms) "
+                 "models other intervals"),
+    })
+
+
 # ----------------------------------------------------------------- driver
 
 LEGS = {
@@ -2072,6 +2204,7 @@ LEGS = {
     "llama_1b": bench_llama_1b,
     "decode": bench_decode,
     "serving_decode": bench_serving_decode,
+    "resilience_overhead": bench_resilience_overhead,
     "vit_huge_lamb": bench_vit_huge_lamb,
     "long_context": bench_long_context,
     "group_norm": bench_group_norm,

@@ -67,6 +67,9 @@ PAGES = {
                "apex_tpu.models.torch_import"],
     "serving": ["apex_tpu.serving.api", "apex_tpu.serving.engine",
                 "apex_tpu.serving.scheduler", "apex_tpu.serving.cache"],
+    "resilience": ["apex_tpu.resilience.faults",
+                   "apex_tpu.resilience.checkpointing",
+                   "apex_tpu.resilience.trainer"],
     "utils": ["apex_tpu.utils.checkpoint", "apex_tpu.utils.profiler",
               "apex_tpu.utils.debug", "apex_tpu.utils.metrics",
               "apex_tpu.utils.tree", "apex_tpu.utils.jax_compat"],

@@ -63,6 +63,13 @@ def parse_args():
     p.add_argument("--synthetic-learnable", action="store_true",
                    help="class-conditional synthetic data so training "
                         "demonstrably converges (prints accuracy)")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="run under apex_tpu.resilience.ResilientLoop: "
+                        "rolling hash-verified checkpoints here, "
+                        "auto-resume, SIGTERM → final checkpoint + "
+                        "clean exit, NaN rewind (docs/resilience.md)")
+    p.add_argument("--ckpt-every", type=int, default=50,
+                   help="checkpoint cadence (steps) for --ckpt-dir")
     return p.parse_args()
 
 
@@ -134,6 +141,14 @@ def main():
     batch_sharding = NamedSharding(mesh, P("data"))
     images = jax.device_put(images, batch_sharding)
     labels = jax.device_put(labels, batch_sharding)
+    # commit the carry replicated over the mesh: a fresh (uncommitted)
+    # state composes with the sharded batch implicitly, but a state
+    # RESTORED from a checkpoint comes back committed to its target's
+    # placement — so the target must already be the placement the step
+    # expects (docs/resilience.md, "restore places like the target")
+    replicated = NamedSharding(mesh, P())
+    state = jax.device_put(state, replicated)
+    batch_stats = jax.device_put(batch_stats, replicated)
 
     # state and batch_stats are replaced every step — donate both so the
     # old copies' HBM is reused (x/y are the same arrays each step and
@@ -155,6 +170,35 @@ def main():
         return new_state, new_bs, loss, acc, finite
 
     with mesh:
+        if args.ckpt_dir:
+            # preemption-safe path: the reference's kill-and-come-back
+            # workflow (save model+optimizer+amp together, restore,
+            # keep training), with the dying part handled too
+            from apex_tpu.resilience import (
+                ResilientCheckpointer, ResilientLoop)
+
+            def loop_step(carry, batch):
+                st, bs = carry
+                st, bs, loss, acc, finite = train_step(st, bs, *batch)
+                return (st, bs), {"loss": loss, "acc": acc,
+                                  "finite": finite}
+
+            loop = ResilientLoop(
+                loop_step,
+                checkpointer=ResilientCheckpointer(args.ckpt_dir,
+                                                   keep=3),
+                checkpoint_every=args.ckpt_every,
+                finite_of=lambda aux: aux["finite"])
+            (state, batch_stats), report = loop.run(
+                (state, batch_stats),
+                lambda step: (images, labels), args.steps)
+            print(f"resilient loop: resumed_from={report.resumed_from} "
+                  f"steps_run={report.steps_run} "
+                  f"preempted={report.preempted} "
+                  f"rewinds={report.rewinds} "
+                  f"checkpoints={report.checkpoints_saved}")
+            return
+
         for step in range(args.steps):
             t0 = time.perf_counter()
             state, batch_stats, loss, acc, finite = train_step(

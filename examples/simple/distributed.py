@@ -6,11 +6,17 @@ The reference launches one process per GPU and wraps the model in
 backward.  TPU-native: one process, a ``Mesh`` over all devices, batch
 sharded on the ``data`` axis — jit inserts the gradient ``psum``.
 
-  python examples/simple/distributed.py
+The loop runs under ``apex_tpu.resilience.ResilientLoop`` — with
+``--ckpt-dir`` it survives kill -TERM (final checkpoint + clean exit)
+and auto-resumes on relaunch; without, the wrapper is a near-free
+pass-through (the ``resilience_overhead`` bench leg quantifies it).
+
+  python examples/simple/distributed.py [--ckpt-dir /tmp/ddp_ckpts]
 """
 
 from __future__ import annotations
 
+import argparse
 import functools
 
 import jax
@@ -21,6 +27,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu import amp, initialize_mesh
 from apex_tpu.optim import fused_sgd
+from apex_tpu.resilience import ResilientCheckpointer, ResilientLoop
 
 
 class Net(nn.Module):
@@ -31,6 +38,11 @@ class Net(nn.Module):
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="rolling checkpoints + auto-resume here")
+    ap.add_argument("--steps", type=int, default=50)
+    args = ap.parse_args()
     # multi-host: pick up MASTER_ADDR/RANK/WORLD_SIZE (the reference
     # launcher's env contract) if set; single-host no-op
     from apex_tpu.parallel import init_distributed
@@ -50,6 +62,9 @@ def main():
     Y = jnp.sum(X[:, :4], axis=1, keepdims=True)
     sharding = NamedSharding(mesh, P("data"))
     X, Y = jax.device_put(X, sharding), jax.device_put(Y, sharding)
+    # committed-replicated carry so a checkpoint-restored state (which
+    # lands on its target's placement) matches the fresh-run placement
+    state = jax.device_put(state, NamedSharding(mesh, P()))
 
     # donate the threaded state; X/Y are reused across the whole loop
     @functools.partial(jax.jit, donate_argnums=(0,))
@@ -60,12 +75,27 @@ def main():
         new_state, _ = state.apply_gradients(grads=grads)
         return new_state, loss
 
+    def loop_step(state, batch):
+        state, loss = train_step(state, *batch)
+        return state, {"loss": loss}
+
+    def show(step, row):
+        if step % 10 == 0 or step == args.steps:
+            print(f"step {step:3d}  loss {row['loss']:.5f}")
+
+    from apex_tpu.utils import MetricsWriter
+    loop = ResilientLoop(
+        loop_step,
+        checkpointer=(ResilientCheckpointer(args.ckpt_dir, keep=2)
+                      if args.ckpt_dir else None),
+        checkpoint_every=20,
+        scalars_of=lambda aux: {"loss": aux["loss"]},
+        metrics=MetricsWriter(sink=show))
     with mesh:
-        for step in range(50):
-            state, loss = train_step(state, X, Y)
-            if step % 10 == 0:
-                print(f"step {step:3d}  loss {float(loss):.5f}")
-    print(f"final loss {float(loss):.5f}")
+        state, report = loop.run(state, lambda s: (X, Y), args.steps)
+    print(f"steps_run {report.steps_run}  "
+          f"resumed_from {report.resumed_from}  "
+          f"preempted {report.preempted}")
 
 
 if __name__ == "__main__":

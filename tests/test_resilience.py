@@ -1,0 +1,576 @@
+"""Resilience layer unit tier (apex_tpu.resilience): deterministic
+fault injection, kill-safe manifest checkpoints, and the ResilientLoop
+escalation ladder — plus the PrefetchLoader retry path and the atomic
+``save_checkpoint`` regression.  The end-to-end kill-and-resume and
+serving chaos soaks live in tests/test_chaos.py (``-m chaos``).
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import utils
+from apex_tpu.resilience import (
+    CheckpointCorrupt,
+    DivergenceError,
+    FaultPlan,
+    FaultSpec,
+    InjectedIOError,
+    Preempted,
+    ResilientCheckpointer,
+    ResilientLoop,
+    TransientStepError,
+    WatchdogConfig,
+    WatchdogTimeout,
+    active,
+    inject,
+    install_plan,
+    clear_plan,
+    plan_from_env,
+    verify_checkpoint,
+)
+from apex_tpu.utils.metrics import Counters, counters
+
+
+class TestFaultPlan:
+    def test_step_pinned_fires_once_per_matching_step(self):
+        plan = FaultPlan([FaultSpec(site="s", kind="io", step=3)])
+        with active(plan):
+            for i in range(3):
+                assert inject("s", step=i) == ()
+            with pytest.raises(InjectedIOError):
+                inject("s", step=3)
+            assert inject("s", step=4) == ()
+
+    def test_times_caps_total_firings(self):
+        plan = FaultPlan([FaultSpec(site="s", kind="io", times=2)])
+        with active(plan):
+            for _ in range(2):
+                with pytest.raises(InjectedIOError):
+                    inject("s")
+            assert inject("s") == ()        # budget spent
+
+    def test_every_and_site_counter(self):
+        # step=None uses the site's own call counter
+        plan = FaultPlan([FaultSpec(site="s", kind="nan", every=3)])
+        with active(plan):
+            fired = [bool(inject("s")) for _ in range(7)]
+        assert fired == [True, False, False, True, False, False, True]
+
+    def test_prob_deterministic_across_replays(self):
+        plan = FaultPlan([FaultSpec(site="s", kind="nan", prob=0.5)],
+                         seed=7)
+
+        def run():
+            plan.reset()
+            with active(plan):
+                return [bool(inject("s", step=i)) for i in range(64)]
+
+        first, second = run(), run()
+        assert first == second
+        assert 5 < sum(first) < 59      # actually probabilistic-ish
+
+    def test_seed_changes_prob_pattern(self):
+        def pattern(seed):
+            plan = FaultPlan(
+                [FaultSpec(site="s", kind="nan", prob=0.5)], seed=seed)
+            with active(plan):
+                return [bool(inject("s", step=i)) for i in range(64)]
+
+        assert pattern(0) != pattern(1)
+
+    def test_slow_sleeps_and_reports(self):
+        import time
+
+        plan = FaultPlan(
+            [FaultSpec(site="s", kind="slow", step=0, delay=0.05)])
+        with active(plan):
+            t0 = time.monotonic()
+            fired = inject("s", step=0)
+            assert time.monotonic() - t0 >= 0.05
+        assert [f.kind for f in fired] == ["slow"]
+
+    def test_transient_carries_slots(self):
+        plan = FaultPlan(
+            [FaultSpec(site="s", kind="transient", slots=(1,))])
+        with active(plan):
+            with pytest.raises(TransientStepError) as ei:
+                inject("s")
+        assert ei.value.slots == (1,)
+
+    def test_json_roundtrip(self):
+        plan = FaultPlan(
+            [FaultSpec(site="a", kind="io", step=5),
+             FaultSpec(site="b", kind="slow", every=2, delay=0.5),
+             FaultSpec(site="c", kind="transient", prob=0.25,
+                       times=3, slots=(0, 2))],
+            seed=11)
+        plan2 = FaultPlan.parse(plan.to_json())
+        assert plan2.seed == 11
+        assert plan2.faults == plan.faults
+
+    def test_env_entry_point(self, monkeypatch):
+        spec = {"seed": 3,
+                "faults": [{"site": "e", "kind": "io", "step": 0}]}
+        monkeypatch.setenv("APEX_TPU_FAULT_PLAN", json.dumps(spec))
+        clear_plan()                        # re-arm the env lookup
+        try:
+            with pytest.raises(InjectedIOError):
+                inject("e", step=0)
+        finally:
+            install_plan(None)              # detach from env for peers
+
+    def test_env_file_form(self, tmp_path, monkeypatch):
+        p = tmp_path / "plan.json"
+        p.write_text(json.dumps(
+            {"faults": [{"site": "f", "kind": "nan", "step": 1}]}))
+        monkeypatch.setenv("APEX_TPU_FAULT_PLAN", f"@{p}")
+        plan = plan_from_env()
+        assert plan.faults[0].site == "f"
+        assert plan.faults[0].kind == "nan"
+
+    def test_no_plan_is_a_cheap_noop(self):
+        install_plan(None)
+        try:
+            assert inject("anything") == ()
+        finally:
+            clear_plan()
+
+    def test_bad_kind_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            FaultSpec(site="s", kind="explode")
+
+    def test_preempt_without_handler_raises(self):
+        # outside a ResilientLoop no SIGTERM handler is installed, so
+        # the injected preemption must surface as Preempted (firing a
+        # real SIG_DFL SIGTERM would kill the test runner)
+        plan = FaultPlan([FaultSpec(site="s", kind="preempt")])
+        prev = signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        try:
+            with active(plan):
+                with pytest.raises(Preempted):
+                    inject("s")
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+
+class TestCounters:
+    def test_inc_get_snapshot_reset(self):
+        c = Counters()
+        assert c.get("x") == 0
+        assert c.inc("x") == 1
+        assert c.inc("x", 4) == 5
+        c.inc("y")
+        assert c.snapshot() == {"x": 5, "y": 1}
+        c.reset()
+        assert c.get("x") == 0
+
+
+class TestAtomicSaveCheckpoint:
+    """Satellite regression: ``save_checkpoint(force=True)`` must stage
+    and atomically swap — a fault mid-save can never destroy the
+    previous checkpoint."""
+
+    def test_io_fault_mid_force_save_preserves_old(self, tmp_path):
+        tree = {"a": jnp.arange(4.0)}
+        path = str(tmp_path / "ckpt")
+        utils.save_checkpoint(path, tree)
+        plan = FaultPlan(
+            [FaultSpec(site="checkpoint.write", kind="io")])
+        with active(plan):
+            with pytest.raises(InjectedIOError):
+                utils.save_checkpoint(
+                    path, {"a": jnp.zeros(4)}, force=True)
+        # the old checkpoint is fully intact, and no staging debris
+        # shadows it
+        restored = utils.restore_checkpoint(path, tree)
+        np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                      np.arange(4.0))
+        stale = [n for n in os.listdir(tmp_path)
+                 if ".stage-" in n or ".prev-" in n]
+        assert stale == [], stale
+
+    def test_force_save_still_overwrites_cleanly(self, tmp_path):
+        tree = {"a": jnp.arange(4.0)}
+        path = str(tmp_path / "ckpt")
+        utils.save_checkpoint(path, tree)
+        utils.save_checkpoint(path, {"a": jnp.zeros(4)}, force=True)
+        restored = utils.restore_checkpoint(path, tree)
+        np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                      np.zeros(4))
+
+    def test_failed_swap_rolls_old_checkpoint_back(self, tmp_path,
+                                                   monkeypatch):
+        """If the stage→path rename of an overwrite fails AFTER the old
+        checkpoint was parked aside, cleanup must put the old one back
+        at ``path`` — never delete the only complete copy and leave
+        nothing restorable."""
+        tree = {"a": jnp.arange(4.0)}
+        path = str(tmp_path / "ckpt")
+        utils.save_checkpoint(path, tree)
+        real_rename = os.rename
+
+        def flaky_rename(src, dst):
+            if dst == path and ".stage-" in src:
+                raise OSError("simulated swap failure")
+            return real_rename(src, dst)
+
+        monkeypatch.setattr(os, "rename", flaky_rename)
+        with pytest.raises(OSError, match="simulated swap"):
+            utils.save_checkpoint(path, {"a": jnp.zeros(4)},
+                                  force=True)
+        monkeypatch.setattr(os, "rename", real_rename)
+        assert os.path.exists(path), "old checkpoint not rolled back"
+        restored = utils.restore_checkpoint(path, tree)
+        np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                      np.arange(4.0))
+
+
+class TestResilientCheckpointer:
+    def _tree(self, scale=1.0):
+        return {"w": jnp.arange(6.0).reshape(2, 3) * scale,
+                "step": jnp.asarray(int(scale), jnp.int32)}
+
+    def test_roundtrip_with_manifest(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path), keep=3)
+        ck.save(10, self._tree())
+        assert ck.all_steps() == [10]
+        manifest = verify_checkpoint(
+            os.path.join(str(tmp_path), "step_00000010"))
+        assert manifest["step"] == 10
+        assert manifest["files"]            # hashed payload exists
+        step, tree = ck.restore_latest(self._tree())
+        assert step == 10
+        np.testing.assert_array_equal(np.asarray(tree["w"]),
+                                      np.asarray(self._tree()["w"]))
+
+    def _corrupt_one_payload_file(self, root):
+        victims = []
+        for base, _dirs, names in os.walk(root):
+            for name in names:
+                if "manifest" in name:
+                    continue
+                full = os.path.join(base, name)
+                if os.path.getsize(full) > 0:
+                    victims.append(full)
+        assert victims, f"no payload files under {root}"
+        victim = sorted(victims)[0]
+        with open(victim, "r+b") as f:
+            blob = f.read(16)
+            f.seek(0)
+            f.write(bytes(b ^ 0xFF for b in blob))
+        return victim
+
+    def test_corrupt_latest_skipped_for_previous(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path), keep=3)
+        ck.save(1, self._tree(1.0))
+        ck.save(2, self._tree(2.0))
+        self._corrupt_one_payload_file(
+            os.path.join(str(tmp_path), "step_00000002"))
+        before = counters.get("checkpoint.corrupt_skipped")
+        step, tree = ck.restore_latest(self._tree())
+        assert step == 1
+        np.testing.assert_array_equal(
+            np.asarray(tree["w"]), np.asarray(self._tree(1.0)["w"]))
+        assert counters.get("checkpoint.corrupt_skipped") > before
+
+    def test_partial_checkpoint_without_manifest_skipped(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path), keep=3)
+        ck.save(1, self._tree(1.0))
+        ck.save(2, self._tree(2.0))
+        os.remove(os.path.join(str(tmp_path), "step_00000002",
+                               "manifest.json"))
+        step, _tree = ck.restore_latest(self._tree())
+        assert step == 1
+
+    def test_verify_raises_on_tamper(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path))
+        ck.save(5, self._tree())
+        root = os.path.join(str(tmp_path), "step_00000005")
+        self._corrupt_one_payload_file(root)
+        with pytest.raises(CheckpointCorrupt, match="hash mismatch"):
+            verify_checkpoint(root)
+
+    def test_rolling_gc_keeps_n(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path), keep=2)
+        for step in (1, 2, 3, 4):
+            ck.save(step, self._tree(float(step)))
+        assert ck.all_steps() == [3, 4]
+
+    def test_io_fault_mid_save_leaves_committed_intact(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path), keep=3)
+        ck.save(1, self._tree(1.0))
+        plan = FaultPlan(
+            [FaultSpec(site="checkpoint.save", kind="io")])
+        with active(plan):
+            with pytest.raises(InjectedIOError):
+                ck.save(2, self._tree(2.0))
+        assert ck.all_steps() == [1]
+        step, _ = ck.restore_latest(self._tree())
+        assert step == 1
+        # no staging debris left behind
+        assert [n for n in os.listdir(str(tmp_path))
+                if n.startswith(".stage-")] == []
+
+    def test_async_save_and_error_surfacing(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path), keep=3)
+        ck.save(1, self._tree(1.0), blocking=False)
+        ck.wait()
+        assert ck.all_steps() == [1]
+        plan = FaultPlan(
+            [FaultSpec(site="checkpoint.save", kind="io")])
+        with active(plan):
+            ck.save(2, self._tree(2.0), blocking=False)
+            ck.wait()
+        # the async failure surfaces on the NEXT save call
+        with pytest.raises(InjectedIOError):
+            ck.save(3, self._tree(3.0))
+        assert ck.all_steps() == [1]
+
+    def test_empty_directory_restores_none(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path))
+        assert ck.restore_latest(self._tree()) is None
+
+
+def _linear_step(carry, batch):
+    carry = jax.tree.map(lambda x: x + batch, carry)
+    finite = bool(np.isfinite(float(jax.tree.leaves(carry)[0][0])))
+    return carry, {"loss": float(batch), "finite": finite}
+
+
+class TestResilientLoop:
+    def test_plain_run_matches_bare_loop(self):
+        loop = ResilientLoop(_linear_step)
+        carry, report = loop.run({"w": jnp.zeros(2)},
+                                 lambda s: np.float32(1.0), 10)
+        assert float(carry["w"][0]) == 10.0
+        assert report.steps_run == 10 and not report.preempted
+
+    def test_injected_preemption_checkpoints_and_resumes(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path), keep=3)
+        loop = ResilientLoop(_linear_step, checkpointer=ck,
+                             checkpoint_every=4)
+        plan = FaultPlan([FaultSpec(site="train.step", kind="preempt",
+                                    step=6, times=1)])
+        with active(plan):
+            carry, report = loop.run({"w": jnp.zeros(2)},
+                                     lambda s: np.float32(1.0), 20)
+        assert report.preempted and report.final_step == 6
+        assert float(carry["w"][0]) == 6.0
+        assert ck.latest_step() == 6        # the preemption checkpoint
+        # relaunch: auto-resume from 6, finish to 20
+        carry, report2 = loop.run({"w": jnp.zeros(2)},
+                                  lambda s: np.float32(1.0), 20)
+        assert report2.resumed_from == 6
+        assert report2.steps_run == 14
+        assert float(carry["w"][0]) == 20.0
+
+    def test_programmatic_preemption(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path))
+        loop = ResilientLoop(_linear_step, checkpointer=ck,
+                             checkpoint_every=100)
+
+        calls = {"n": 0}
+
+        def data_fn(step):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                loop.request_preemption()
+            return np.float32(1.0)
+
+        carry, report = loop.run({"w": jnp.zeros(2)}, data_fn, 50)
+        assert report.preempted
+        assert 3 <= report.final_step <= 4
+        assert ck.latest_step() == report.final_step
+
+    def test_nan_escalation_rewinds_to_checkpoint(self, tmp_path):
+        """The ladder's rung 2: a transient NaN burst (injected once)
+        trips the sentinel, the loop rewinds to the last good
+        checkpoint and completes with finite state."""
+        ck = ResilientCheckpointer(str(tmp_path), keep=3)
+        loop = ResilientLoop(_linear_step, checkpointer=ck,
+                             checkpoint_every=5,
+                             finite_of=lambda aux: aux["finite"],
+                             nan_tolerance=2, max_rewinds=2)
+        plan = FaultPlan([FaultSpec(site="train.compute", kind="nan",
+                                    step=7, times=1)])
+        with active(plan):
+            carry, report = loop.run({"w": jnp.zeros(2)},
+                                     lambda s: np.float32(1.0), 12)
+        assert report.rewinds == 1
+        assert report.nonfinite_steps >= 2
+        assert np.all(np.isfinite(np.asarray(carry["w"])))
+        # rewound to step 5, replayed 5..12 clean (fault spent)
+        assert float(carry["w"][0]) == 12.0
+
+    def test_divergence_abort_with_diagnostics(self):
+        # no checkpointer -> no rewind rung -> abort with a report
+        loop = ResilientLoop(_linear_step,
+                             finite_of=lambda aux: aux["finite"],
+                             nan_tolerance=2, max_rewinds=1)
+        plan = FaultPlan([FaultSpec(site="train.compute", kind="nan")])
+        with active(plan):
+            with pytest.raises(DivergenceError) as ei:
+                loop.run({"w": jnp.zeros(2)},
+                         lambda s: np.float32(1.0), 10)
+        report = ei.value.report
+        assert report.diagnostics["nan_tolerance"] == 2
+        assert report.nonfinite_steps >= 2
+        assert "counters" in report.diagnostics
+
+    def test_rewind_budget_exhausted_aborts(self, tmp_path):
+        # the fault re-fires forever -> every rewind replays into the
+        # same NaN -> the ladder must abort, not loop
+        ck = ResilientCheckpointer(str(tmp_path), keep=2)
+        loop = ResilientLoop(_linear_step, checkpointer=ck,
+                             checkpoint_every=2,
+                             finite_of=lambda aux: aux["finite"],
+                             nan_tolerance=1, max_rewinds=2)
+        plan = FaultPlan([FaultSpec(site="train.compute", kind="nan",
+                                    steps=tuple(range(3, 100)))])
+        with active(plan):
+            with pytest.raises(DivergenceError) as ei:
+                loop.run({"w": jnp.zeros(2)},
+                         lambda s: np.float32(1.0), 20)
+        assert ei.value.report.rewinds == 3     # 2 spent + the fatal one
+
+    def test_watchdog_dumps_and_raises(self, tmp_path):
+        dump = str(tmp_path / "watchdog.txt")
+        loop = ResilientLoop(
+            _linear_step,
+            watchdog=WatchdogConfig(min_deadline=0.2,
+                                    deadline_factor=50.0,
+                                    warmup_steps=1, poll=0.02,
+                                    dump_path=dump))
+        plan = FaultPlan([FaultSpec(site="train.compute", kind="slow",
+                                    step=3, delay=0.8)])
+        with active(plan):
+            with pytest.raises(WatchdogTimeout):
+                loop.run({"w": jnp.zeros(2)},
+                         lambda s: np.float32(1.0), 10)
+        blob = open(dump).read()
+        assert "live thread stacks" in blob
+        assert "device / mesh state" in blob
+        assert "MainThread" in blob
+
+    def test_watchdog_quiet_on_healthy_steps(self):
+        loop = ResilientLoop(
+            _linear_step,
+            watchdog=WatchdogConfig(min_deadline=30.0, poll=0.02))
+        carry, report = loop.run({"w": jnp.zeros(2)},
+                                 lambda s: np.float32(1.0), 8)
+        assert not report.watchdog_fired
+        assert float(carry["w"][0]) == 8.0
+
+    def test_loss_scale_diag_in_divergence_report(self):
+        """The diagnostic includes the loss-scaler state when the carry
+        is a MixedPrecisionTrainState — the backoff_exhausted hand-off
+        from DynamicLossScale's own state machine."""
+        import optax
+
+        from apex_tpu import amp
+
+        params = {"w": jnp.ones((2, 2))}
+        state = amp.initialize(
+            lambda p, x: x @ p["w"], params, optax.sgd(1e-2),
+            opt_level="O2", half_dtype=jnp.bfloat16)
+
+        def step(carry, batch):
+            def loss_fn(p):
+                return carry.scale_loss(
+                    jnp.sum(carry.apply_fn(p, batch) ** 2))
+            grads = jax.grad(loss_fn)(carry.compute_params())
+            new_state, finite = carry.apply_gradients(grads=grads)
+            return new_state, {"finite": finite}
+
+        loop = ResilientLoop(step,
+                             finite_of=lambda aux: aux["finite"],
+                             nan_tolerance=1, max_rewinds=0)
+        plan = FaultPlan([FaultSpec(site="train.compute", kind="nan")])
+        with active(plan):
+            with pytest.raises(DivergenceError) as ei:
+                loop.run(state, lambda s: jnp.ones((1, 2)), 5)
+        diag = ei.value.report.diagnostics
+        assert "loss_scale" in diag
+        assert "loss_scale_backoff_exhausted" in diag
+
+
+class TestBackoffExhausted:
+    def test_flags_only_at_min_scale(self):
+        from apex_tpu.core.loss_scale import DynamicLossScale
+
+        ls = DynamicLossScale(init_scale=4.0, min_scale=1.0)
+        state = ls.init()
+        assert not bool(ls.backoff_exhausted(state))
+        for _ in range(3):      # 4 -> 2 -> 1 (clamped)
+            state = ls.adjust(state, jnp.asarray(False))
+        assert float(state.loss_scale) == 1.0
+        assert bool(ls.backoff_exhausted(state))
+
+
+class _FlakySource:
+    """__next__ raises OSError on chosen calls; safe to re-pull."""
+
+    def __init__(self, n=4, fail_calls=()):
+        self.n = n
+        self.fail_calls = set(fail_calls)
+        self.calls = 0
+        self.emitted = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self.calls += 1
+        if self.calls in self.fail_calls:
+            raise OSError(f"flaky read #{self.calls}")
+        if self.emitted >= self.n:
+            raise StopIteration
+        self.emitted += 1
+        return np.full((2,), float(self.emitted), np.float32)
+
+
+class TestPrefetchRetry:
+    def test_retries_absorb_transient_failures(self):
+        from apex_tpu.data.prefetch import PrefetchLoader
+
+        before = counters.get("data.retry")
+        src = _FlakySource(n=4, fail_calls={2, 5})
+        out = [float(np.asarray(b)[0])
+               for b in PrefetchLoader(src, retries=2,
+                                       retry_backoff=0.01)]
+        assert out == [1.0, 2.0, 3.0, 4.0]
+        assert counters.get("data.retry") - before == 2
+
+    def test_exhausted_retries_surface_in_consumer(self):
+        from apex_tpu.data.prefetch import PrefetchLoader
+
+        src = _FlakySource(n=4, fail_calls={2, 3, 4, 5})
+        loader = PrefetchLoader(src, retries=2, retry_backoff=0.01)
+        with pytest.raises(OSError, match="flaky read"):
+            list(loader)
+
+    def test_zero_retries_is_the_old_behavior(self):
+        from apex_tpu.data.prefetch import PrefetchLoader
+
+        src = _FlakySource(n=4, fail_calls={2})
+        with pytest.raises(OSError):
+            list(PrefetchLoader(src))
+
+    def test_injected_data_fault_is_retried(self):
+        from apex_tpu.data.prefetch import PrefetchLoader
+
+        plan = FaultPlan([FaultSpec(site="data.next", kind="io",
+                                    step=1, times=1)])
+        src = _FlakySource(n=3)
+        with active(plan):
+            out = [float(np.asarray(b)[0])
+                   for b in PrefetchLoader(src, retries=1,
+                                           retry_backoff=0.01)]
+        assert out == [1.0, 2.0, 3.0]

@@ -480,3 +480,61 @@ class TestInferenceServer:
         server.shutdown()
         with pytest.raises(ServerClosed):
             server.submit(np.zeros(2, np.int32), max_new_tokens=1)
+
+
+class TestHandleErrorContract:
+    """RequestHandle.stream/result error taxonomy (docs/resilience.md):
+    TimeoutError = retryable "no token yet"; ServerClosed /
+    RequestFailed = terminal.  A shutdown race must never surface as a
+    bare timeout."""
+
+    def test_timeout_is_retryable_not_terminal(self, gpt):
+        model, params = gpt
+        server = InferenceServer(model, params, max_slots=1,
+                                 prompt_buckets=(4,))
+        server.start(warmup=False)      # first token needs a compile
+        try:
+            h = server.submit(np.zeros(3, np.int32), max_new_tokens=3)
+            with pytest.raises(TimeoutError, match="retryable"):
+                h.result(timeout=1e-4)
+            # the request was NOT terminated by that timeout: the same
+            # handle still completes
+            assert len(h.result(timeout=300)) == 3
+            assert h.error is None
+        finally:
+            server.shutdown(timeout=60)
+
+    def test_shutdown_surfaces_terminal_not_timeout(self, gpt):
+        from apex_tpu.serving import ServerClosed
+
+        model, params = gpt
+        server = InferenceServer(model, params, max_slots=1,
+                                 prompt_buckets=(4,))
+        server.start(warmup=False)
+        h = server.submit(np.zeros(3, np.int32), max_new_tokens=200)
+        # wait=False cancels in-flight requests; after the worker has
+        # joined, the handle MUST report the terminal ServerClosed even
+        # with a tiny timeout — the old shutdown race surfaced here as
+        # a bare TimeoutError
+        server.shutdown(wait=False, timeout=120)
+        with pytest.raises(ServerClosed):
+            h.result(timeout=0.001)
+        with pytest.raises(ServerClosed):
+            list(h.stream(timeout=0.001))
+        assert isinstance(h.error, ServerClosed)
+
+    def test_deadline_failure_is_request_failed(self, gpt):
+        from apex_tpu.serving import RequestFailed
+
+        model, params = gpt
+        server = InferenceServer(model, params, max_slots=1,
+                                 prompt_buckets=(4,))
+        with server:
+            h = server.submit(np.zeros(3, np.int32),
+                              max_new_tokens=100, deadline=1e-4)
+            with pytest.raises(RequestFailed, match="deadline"):
+                h.result(timeout=300)
+            # the failure is per-request: the server keeps serving
+            h2 = server.submit(np.zeros(2, np.int32), max_new_tokens=2)
+            assert len(h2.result(timeout=300)) == 2
+            assert server.health()["ready"]
