@@ -381,6 +381,13 @@ def generate(model, params, prompt_ids, *, max_new_tokens: int,
     """
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     b, prompt_len = prompt_ids.shape
+    if getattr(model.cfg, "kv_cache", "dense") == "paged":
+        raise ValueError(
+            "generate() drives the dense KV-cache; a kv_cache='paged' "
+            "model needs the block tables the serving engine owns — "
+            "serve it through apex_tpu.serving.PagedEngine (dense and "
+            "paged compute the same function, so build the generate() "
+            "twin with dataclasses.replace(cfg, kv_cache='dense'))")
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")

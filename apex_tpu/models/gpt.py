@@ -82,7 +82,21 @@ class GPTModel(nn.Module):
             pos_table = self.param(
                 "position_embedding", nn.initializers.normal(0.02),
                 (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
-            if decode:
+            if decode and cfg.kv_cache == "paged":
+                # paged serving: positions are PER ROW (a ragged batch
+                # of tenants, each at its own cursor).  The engine
+                # overwrites this leaf every step alongside the
+                # per-layer cursors; pad positions clamp into the table
+                # (their outputs are ignored and their K/V unreachable)
+                pi = self.variable(
+                    "cache", "position_index",
+                    lambda: jnp.zeros((x.shape[0],), jnp.int32))
+                positions = jnp.minimum(
+                    pi.value[:, None]
+                    + jnp.arange(x.shape[1], dtype=jnp.int32),
+                    cfg.max_seq_len - 1)
+                x = x + pos_table[positions].astype(x.dtype)
+            elif decode:
                 # incremental decoding: positions continue from the
                 # model-level cache index (the per-layer attention
                 # caches track their own — they advance in lockstep)

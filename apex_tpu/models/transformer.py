@@ -29,6 +29,7 @@ import flax.linen as nn
 from apex_tpu.core.mesh import TENSOR_AXIS
 from apex_tpu.ops.attention import fused_attention
 from apex_tpu.ops.layer_norm import fused_layer_norm, fused_rms_norm
+from apex_tpu.ops.paged_attention import paged_attention
 from apex_tpu.ops.mlp import resolve_activation
 from apex_tpu.ops.rope import fused_rope, rope_cos_sin
 from apex_tpu.transformer.layers import (
@@ -111,6 +112,18 @@ class TransformerConfig:
     # key, so A/B flips retrace instead of silently replaying the old
     # executable (ADVICE round 5; graftlint env-read-in-trace).
     decode_attn: str = "auto"
+    # decode KV-cache layout: "dense" (one (b, max_seq_len, kv_heads,
+    # d) slab per layer, the generate()/slotted-engine substrate) or
+    # "paged" (a shared (kv_heads, kv_pool_blocks, kv_block_size, d)
+    # page pool per layer + per-row block tables/cursors riding the
+    # cache collection — the serving engine's token-granular layout;
+    # attention goes through ops.paged_attention and positions are
+    # per-ROW, so one application serves a ragged batch of tenants).
+    # Only apex_tpu.serving.PagedEngine drives the paged mode; block 0
+    # of every pool is the null page pad-token writes land in.
+    kv_cache: str = "dense"
+    kv_block_size: int = 16                 # tokens per page (paged)
+    kv_pool_blocks: int = 0                 # pool pages incl. null page
     # flash-attention kernel tile sizes; None = the kernel's seq-aware
     # default (512 at short seq — isolated-op sweeps can mislead: in
     # the full rematted model 512/512 measures fastest at s=512 — and
@@ -167,6 +180,26 @@ class TransformerConfig:
             raise ValueError(
                 f"decode_attn={self.decode_attn!r} not in "
                 "('auto', 'einsum', 'blocked')")
+        if self.kv_cache not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_cache={self.kv_cache!r} not in ('dense', 'paged')")
+        if self.kv_cache == "paged":
+            if not self.causal:
+                raise ValueError("kv_cache='paged' requires causal=True "
+                                 "(it is a decode-cache layout)")
+            if self.sliding_window is not None:
+                raise ValueError(
+                    "kv_cache='paged' does not support sliding_window "
+                    "— the paged pool already bounds decode memory to "
+                    "live tokens; serve with sliding_window=None")
+            if self.kv_block_size < 1:
+                raise ValueError(
+                    f"kv_block_size must be >= 1, got "
+                    f"{self.kv_block_size}")
+            if self.kv_pool_blocks < 2:
+                raise ValueError(
+                    "kv_pool_blocks must be >= 2 (block 0 is the "
+                    f"reserved null page), got {self.kv_pool_blocks}")
         if self.num_moe_experts is not None:
             if self.num_moe_experts < 2:
                 raise ValueError(
@@ -316,6 +349,24 @@ def _cache_attention_blocked(q, keys, values, idx, scale, window=None,
     return o.reshape(b, s, h, d).astype(q.dtype)
 
 
+def _rope_rows(x, cos_b, sin_b):
+    """Half-rotation RoPE with PER-ROW position tables.
+
+    ``x`` (b, s, heads, d); ``cos_b``/``sin_b`` (b, s, 1, rot/2) —
+    gathered at each row's absolute positions.  The shared-table
+    :func:`~apex_tpu.ops.rope.fused_rope` broadcasts one (s, rot/2)
+    table over the batch, which cannot express a ragged batch of
+    tenants each at its own decode position (the paged serving path).
+    """
+    half = cos_b.shape[-1]
+    rot = 2 * half
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:rot].astype(jnp.float32)
+    o1 = (x1 * cos_b - x2 * sin_b).astype(x.dtype)
+    o2 = (x2 * cos_b + x1 * sin_b).astype(x.dtype)
+    return jnp.concatenate([o1, o2, x[..., rot:]], axis=-1)
+
+
 class ParallelAttention(nn.Module):
     """TP attention block: ColumnParallel qkv → RoPE → flash → RowParallel.
 
@@ -341,6 +392,64 @@ class ParallelAttention(nn.Module):
     """
 
     cfg: TransformerConfig
+
+    def _paged_decode(self, q, k, v, rot):
+        """Chunk/decode attention over the PAGED KV pool
+        (``cfg.kv_cache == "paged"``; serving-engine substrate).
+
+        Cache leaves: a shared per-layer page pool ``paged_key`` /
+        ``paged_value`` of ``(kv_heads, kv_pool_blocks, kv_block_size,
+        d)`` plus per-row ``block_tables`` (logical page → physical
+        pool block) and ``cursors`` (tokens already cached).  The
+        serving engine OWNS the tables/cursors — it overwrites both
+        leaves every step from its host allocator (this module never
+        advances them), which is what makes one application serve a
+        ragged batch: every row sits at its own position.
+
+        Write-then-attend, like the dense path: the chunk's K/V are
+        scattered into the pool at ``cursor + i`` first, then every
+        query attends over the pool by absolute position — within-chunk
+        causality falls out of the position mask.  Pad tokens beyond a
+        row's real chunk write into the null page (block 0, where
+        unallocated table entries point) or into positions the next
+        real token overwrites before any query can see them.
+        """
+        cfg = self.cfg
+        b, s, hk, d = k.shape
+        S = cfg.max_seq_len
+        NB, BS = cfg.kv_pool_blocks, cfg.kv_block_size
+        MB = -(-S // BS)
+        pk = self.variable("cache", "paged_key", jnp.zeros,
+                           (hk, NB, BS, d), k.dtype)
+        pv = self.variable("cache", "paged_value", jnp.zeros,
+                           (hk, NB, BS, d), v.dtype)
+        bt = self.variable("cache", "block_tables", jnp.zeros,
+                           (b, MB), jnp.int32)
+        cur = self.variable("cache", "cursors", jnp.zeros,
+                            (b,), jnp.int32)
+        positions = cur.value[:, None] + jnp.arange(s, dtype=jnp.int32)
+        if cfg.position_embedding == "rope" and rot:
+            # per-ROW rope: each tenant rotates at its own absolute
+            # position (the shared-table fused_rope cannot express a
+            # ragged batch); pad positions clamp into the table — their
+            # K/V are unreachable garbage either way
+            cos, sin = rope_cos_sin(S, rot, base=cfg.rope_base)
+            pc = jnp.minimum(positions, S - 1)
+            cb, sb = cos[pc][:, :, None, :], sin[pc][:, :, None, :]
+            q = _rope_rows(q, cb, sb)
+            k = _rope_rows(k, cb, sb)
+        logical = jnp.minimum(positions // BS, MB - 1)
+        phys = jnp.take_along_axis(bt.value, logical, axis=1)  # (b, s)
+        # pad positions past max_seq_len go to the NULL page — the
+        # clamped logical index above would land them in the row's
+        # LAST allocated block, overwriting live (visible) entries
+        # when a near-full tenant rides a wide mixed step
+        phys = jnp.where(positions < S, phys, 0)
+        off = positions % BS
+        pk.value = pk.value.at[:, phys, off].set(k.transpose(2, 0, 1, 3))
+        pv.value = pv.value.at[:, phys, off].set(v.transpose(2, 0, 1, 3))
+        return paged_attention(q, pk.value, pv.value, bt.value,
+                               cur.value, scale=d ** -0.5)
 
     @nn.compact
     def __call__(self, x, *, mask_bias=None, deterministic: bool = True,
@@ -387,6 +496,14 @@ class ParallelAttention(nn.Module):
             # max_seq_len — the index is traced, so it cannot be
             # validated here; dynamic_update_slice would silently clamp.
             # generate() enforces the bound statically.
+            if cfg.kv_cache == "paged":
+                o = self._paged_decode(q, k, v, rot)
+                return RowParallelLinear(
+                    features=cfg.hidden_size,
+                    use_bias=cfg.add_bias_linear,
+                    sequence_parallel=cfg.sequence_parallel,
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    name="out_proj")(o.reshape(b, s, h * d))
             S = cfg.max_seq_len
             # rolling ring-buffer cache (Mistral design): with a
             # sliding window only the last `window` keys are ever

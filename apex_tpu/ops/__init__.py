@@ -36,6 +36,10 @@ from apex_tpu.ops.batch_norm import (
     batch_norm_reference,
 )
 from apex_tpu.ops.attention import fused_attention, attention_reference
+from apex_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+)
 from apex_tpu.ops.multihead_attn import SelfMultiheadAttn, EncdecMultiheadAttn
 
 __all__ = [
@@ -48,5 +52,6 @@ __all__ = [
     "group_norm", "GroupNorm",
     "batch_norm_train", "batch_norm_inference", "batch_norm_reference",
     "fused_attention", "attention_reference",
+    "paged_attention", "paged_attention_reference",
     "SelfMultiheadAttn", "EncdecMultiheadAttn",
 ]

@@ -43,7 +43,7 @@ import time
 from typing import Dict, Iterable, Optional
 
 __all__ = ["cached_block_rows", "tune_layer_norm", "tune_softmax",
-           "tune_batch_norm", "clear_cache"]
+           "tune_batch_norm", "tune_paged_attention", "clear_cache"]
 
 _CACHE: Optional[Dict[str, int]] = None
 
@@ -216,6 +216,63 @@ def tune_batch_norm(n_rows: int = 65536, width: int = 256,
                  candidates)
 
 
+def tune_paged_attention(n_rows: int = 8, width: int = 128,
+                         dtype="bfloat16", kv_heads: int = 8,
+                         live_tokens: int = 1024,
+                         candidates: Iterable[int] = (8, 16, 32, 64,
+                                                      128)) -> int:
+    """Sweep the paged KV-cache **page size** (tokens per block) for
+    the decode step at (batch=``n_rows``, head_dim=``width``).
+
+    Unlike the row-wise sweeps the tunable here is the cache *layout*
+    parameter itself: small pages waste less pool on the last partial
+    page per sequence but issue more (and smaller) gather DMAs per
+    step; large pages amortize the DMA at the cost of internal
+    fragmentation.  The pool is sized to the sweep (``n_rows`` rows at
+    ``live_tokens`` live, shuffled physical placement), so any
+    rows/width combination measures.  The serving engine
+    (``apex_tpu.serving.PagedEngine``) picks the measured winner up by
+    default when ``block_size`` is not given; its lookup key is
+    (device, "paged_attention", **head_dim**, dtype) — from the CLI
+    pass the model's head_dim as ``--widths`` (NOT the hidden size)
+    and the serving batch as ``--rows``::
+
+        python -m apex_tpu.ops.autotune --ops paged_attention \\
+            --widths 128 --rows 16
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.ops.paged_attention import paged_attention as _paged
+
+    # n_rows arrives from the shared --rows CLI flag whose row-wise
+    # default (8192) means activation rows; a decode BATCH that size
+    # is meaningless and would OOM the pool — clamp to serving scale
+    n_rows = max(1, min(int(n_rows), 256))
+    dt = jnp.dtype(dtype)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(n_rows, 1, kv_heads, width)), dt)
+
+    def build(bs):
+        mb = -(-live_tokens // bs)
+        nb = n_rows * mb + 1           # pool sized to the sweep
+        kp = jnp.asarray(
+            rng.normal(size=(kv_heads, nb, bs, width)), dt)
+        vp = jnp.asarray(
+            rng.normal(size=(kv_heads, nb, bs, width)), dt)
+        free = np.arange(1, nb, dtype=np.int32)
+        rng.shuffle(free)
+        tables = free[: n_rows * mb].reshape(n_rows, mb).copy()
+        lengths = jnp.full((n_rows,), live_tokens - 1, jnp.int32)
+        fn = jax.jit(lambda q: _paged(
+            q, kp, vp, jnp.asarray(tables), lengths))
+        return fn, (q,)
+
+    return _tune("paged_attention", build, 10 ** 9, width, str(dt),
+                 candidates)
+
+
 def main(argv=None):
     import argparse
 
@@ -224,13 +281,15 @@ def main(argv=None):
     p.add_argument("--rows", type=int, default=8192)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--ops", nargs="+", default=["layer_norm", "softmax"],
-                   choices=["layer_norm", "softmax", "batch_norm"])
+                   choices=["layer_norm", "softmax", "batch_norm",
+                            "paged_attention"])
     args = p.parse_args(argv)
     for width in args.widths:
         for op in args.ops:
             tune = {"layer_norm": tune_layer_norm,
                     "softmax": tune_softmax,
-                    "batch_norm": tune_batch_norm}[op]
+                    "batch_norm": tune_batch_norm,
+                    "paged_attention": tune_paged_attention}[op]
             best = tune(n_rows=args.rows, width=width, dtype=args.dtype)
             print(f"{op} w={width}: best block_rows={best} "
                   f"(cache: {_cache_path()})")

@@ -1,0 +1,269 @@
+"""Paged-attention decode — block-table-gathered KV attention.
+
+The serving engine's paged KV-cache (``apex_tpu.serving``) stores K/V
+in fixed-size **pages** of a shared pool instead of a dense
+``max_slots × max_seq_len`` slab: page ``p`` of sequence ``b`` lives at
+physical pool block ``block_tables[b, p]``, and the pool is sized in
+*tokens* (``num_blocks × block_size``), shared by every co-resident
+tenant.  This op computes one decode/chunk attention step over that
+layout: each query row attends over exactly its own pages, gathered
+through its block table.
+
+Why it matters: the dense slab's steady decode reads (or at best
+cond-skips over) a ``max_seq_len`` cache row per slot per step, and its
+HBM *footprint* reserves ``max_slots × max_seq_len`` tokens no matter
+how short the live sequences are.  Here both the footprint and the
+per-step bytes scale with **live tokens**: a slot at position ``L``
+owns ``ceil((L+1)/block_size)`` pages and the kernel touches only
+those (the TPU-serving recipe of "Fine-Tuning and Serving Gemma on
+Cloud TPU", PAPERS.md).
+
+Layouts::
+
+    q             (batch, s, num_heads, head_dim)   s = chunk (1 = decode)
+    k_pages       (kv_heads, num_blocks, block_size, head_dim)
+    v_pages       (kv_heads, num_blocks, block_size, head_dim)
+    block_tables  (batch, pages_per_seq)  int32 physical block ids
+    lengths       (batch,)  int32 — tokens already cached *before* this
+                  chunk; query i of row b sits at position lengths[b]+i
+
+The chunk's own K/V must already be written into the pool (the model's
+write-then-attend convention, ``models/transformer.py``); visibility is
+by absolute position — key position ``p`` is visible to query ``i``
+iff ``p <= lengths[b] + i`` — so garbage beyond the cursor (freed
+pages, pad-token writes) is never read.  Physical block 0 is the
+engine's **null page** (pad writes land there); the mask makes its
+contents unreachable, so the op needs no special case for it.
+
+Two implementations under the :mod:`apex_tpu.ops._dispatch`
+conventions:
+
+- **Pallas TPU kernel** (``implementation="pallas"``): grid
+  ``(batch, kv_heads, pages_per_seq)`` with the page axis sequential;
+  the block table and lengths ride **scalar prefetch**
+  (``pltpu.PrefetchScalarGridSpec``) so the K/V BlockSpec index maps
+  resolve logical→physical pages before each DMA.  Pages past a row's
+  live prefix are *clamped to the last live page* in the index map —
+  consecutive identical block indices skip the DMA — and the body is
+  ``pl.when``-skipped, so per-step bytes scale with the row's live
+  tokens, not ``pages_per_seq``.  Online softmax runs in the log2
+  domain with the transposed (keys-on-sublanes) score tiles of
+  ``ops/attention.py``.
+- **XLA gather reference** (``implementation="xla"``; golden semantics,
+  CPU/GPU fallback): ``k_pages[:, block_tables]`` then a masked fp32
+  einsum — bit-comparable to the dense engine's cache attention.
+
+The *block size itself* is the tunable (the analogue of the row-wise
+kernels' block-rows): sweep it offline with
+``apex_tpu.ops.autotune.tune_paged_attention`` and the serving engine
+picks the measured winner up by default.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._dispatch import resolve_impl
+
+__all__ = ["paged_attention", "paged_attention_reference"]
+
+_NEG_INF = -1e30
+_LOG2E = 1.4426950408889634
+
+
+# --------------------------------------------------------------------- #
+# XLA reference (golden semantics; CPU/GPU fallback)
+# --------------------------------------------------------------------- #
+def paged_attention_reference(q, k_pages, v_pages, block_tables,
+                              lengths, *, scale: Optional[float] = None):
+    """Gather-then-attend reference: softmax(q·K_gatheredᵀ·scale)·V.
+
+    Shapes as in the module docstring.  The gather materializes each
+    row's ``pages_per_seq × block_size`` keys (reference semantics —
+    the Pallas kernel never does); masking is by absolute position, so
+    pool garbage beyond ``lengths[b] + i`` is unreachable.  fp32
+    softmax, output in ``q.dtype`` — the same numerics contract as the
+    dense engine's cache attention.
+    """
+    b, s, h, d = q.shape
+    hk, _nb, bs, _ = k_pages.shape
+    rep = h // hk
+    scale = (d ** -0.5) if scale is None else scale
+    mb = block_tables.shape[1]
+    # (hk, b, mb, bs, d) -> (b, mb*bs, hk, d): logical order restored,
+    # so key position == gathered index
+    keys = jnp.moveaxis(k_pages[:, block_tables], 0, 3)
+    vals = jnp.moveaxis(v_pages[:, block_tables], 0, 3)
+    keys = keys.reshape(b, mb * bs, hk, d)
+    vals = vals.reshape(b, mb * bs, hk, d)
+    qg = q.reshape(b, s, hk, rep, d).astype(jnp.float32)
+    scores = jnp.einsum("bsgrd,bkgd->bsgrk", qg,
+                        keys.astype(jnp.float32)) * scale
+    pos_q = lengths[:, None] + jnp.arange(s, dtype=jnp.int32)  # (b, s)
+    k_pos = jnp.arange(mb * bs, dtype=jnp.int32)
+    visible = k_pos[None, None, :] <= pos_q[:, :, None]        # (b, s, K)
+    scores = jnp.where(visible[:, :, None, None, :], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bsgrk,bkgd->bsgrd", p, vals.astype(jnp.float32))
+    return o.reshape(b, s, h, d).astype(q.dtype)
+
+
+# --------------------------------------------------------------------- #
+# Pallas TPU kernel
+# --------------------------------------------------------------------- #
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, bs, s, rep, scale, nb):
+    """One (row, kv-head, page) step of the online-softmax sweep.
+
+    Score tiles are TRANSPOSED — (bs, rep·s): key slots on sublanes,
+    (q-head, chunk-offset) lanes — so the softmax statistics are native
+    lane rows and the value accumulation contracts over the page at
+    full MXU shape (the ops/attention.py layout, measured there).
+    Lane ``l`` holds q head ``l // s`` at chunk offset ``l % s``.
+    """
+    row = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = lens_ref[row]
+    last_q = length + s - 1
+
+    def _step():
+        qs = q_ref[0, 0] * jnp.asarray(scale * _LOG2E, q_ref.dtype)
+        sc = jax.lax.dot_general(
+            k_ref[0, 0], qs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (bs, rep*s)
+        k_pos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (bs, rep * s), 0)
+        q_off = jax.lax.broadcasted_iota(
+            jnp.int32, (bs, rep * s), 1) % s
+        sc = jnp.where(k_pos > length + q_off, _NEG_INF, sc)
+        m_prev = m_ref[:]                            # (1, rep*s)
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=0, keepdims=True))
+        # every lane sees >= 1 live key in page 0 (position 0 is always
+        # visible), so m is finite from the first visited page on and
+        # exp2(-1e30 - m) underflows to exactly 0 at dead positions —
+        # no explicit dead-row zeroing needed (see ops/attention.py)
+        p = jnp.exp2(sc - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=0, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            v_ref[0, 0], p.astype(v_ref.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (d, rep*s)
+        m_ref[:] = m_new
+
+    # pages wholly past the row's newest query hold nothing visible —
+    # skip the body (their DMA is also skipped: the index map clamps
+    # dead pages to the last live page, and a repeated block index
+    # fetches nothing new)
+    pl.when(j * bs <= last_q)(_step)
+
+    @pl.when(j == nb - 1)
+    def _final():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = jnp.transpose(acc_ref[:] / l_safe).astype(
+            o_ref.dtype)
+
+
+def _run_paged(q4, k_pages, v_pages, tables, lengths, scale, interpret):
+    b, s, h, d = q4.shape
+    hk, _nb_pool, bs, _ = k_pages.shape
+    rep = h // hk
+    mb = tables.shape[1]
+    # (b, s, h, d) -> (b, hk, rep*s, d): lane l = (head r)*s + offset i
+    q3 = (q4.reshape(b, s, hk, rep, d)
+          .transpose(0, 2, 3, 1, 4).reshape(b, hk, rep * s, d))
+
+    def _kv_map(row, head, j, tables_ref, lens_ref):
+        # logical page -> physical pool block via the prefetched table;
+        # dead pages (past the live prefix) clamp to the last live page
+        # so their DMA is a no-op revisit
+        live = jnp.maximum(lens_ref[row] + s - 1, 0) // bs
+        return head, tables_ref[row, jnp.minimum(j, live)], 0, 0
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hk, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, rep * s, d),
+                         lambda row, head, j, *_: (row, head, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), _kv_map),
+            pl.BlockSpec((1, 1, bs, d), _kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, rep * s, d),
+            lambda row, head, j, *_: (row, head, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, rep * s), jnp.float32),   # m (lane row)
+            pltpu.VMEM((1, rep * s), jnp.float32),   # l (lane row)
+            pltpu.VMEM((d, rep * s), jnp.float32),   # transposed acc
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, bs=bs, s=s, rep=rep,
+                               scale=scale, nb=mb)
+    o3 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hk, rep * s, d), q4.dtype),
+        interpret=interpret,
+    )(tables, lengths, q3, k_pages, v_pages)
+    return (o3.reshape(b, hk, rep, s, d)
+            .transpose(0, 3, 1, 2, 4).reshape(b, s, h, d))
+
+
+# --------------------------------------------------------------------- #
+# public API
+# --------------------------------------------------------------------- #
+def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
+                    scale: Optional[float] = None,
+                    implementation: Optional[str] = None):
+    """Attention of chunk queries over a paged KV pool (shapes in the
+    module docstring).
+
+    Inference-only (the decode path has no backward); the chunk's own
+    K/V must already be written into the pool.  ``implementation``
+    follows :mod:`apex_tpu.ops._dispatch`: ``"auto"`` picks the Pallas
+    kernel on TPU when the geometry fits its envelope (``block_size``
+    and ``head_dim`` multiples of 8, GQA head ratio integral) and the
+    gather reference elsewhere; the serving engine's ``kv_cache="dense"``
+    slab path remains the non-paged fallback one level up.
+    """
+    b, s, h, d = q.shape
+    if k_pages.shape != v_pages.shape:
+        raise ValueError(
+            f"k_pages/v_pages shapes differ: {k_pages.shape} vs "
+            f"{v_pages.shape}")
+    hk, _nb, bs, dk = k_pages.shape
+    if dk != d:
+        raise ValueError(
+            f"head_dim mismatch: q has {d}, pages have {dk}")
+    if h % hk:
+        raise ValueError(
+            f"kv_heads ({hk}) must divide num_heads ({h})")
+    if block_tables.shape[0] != b or lengths.shape != (b,):
+        raise ValueError(
+            f"block_tables {block_tables.shape} / lengths "
+            f"{lengths.shape} do not match batch {b}")
+    scale = (d ** -0.5) if scale is None else float(scale)
+    pallas_ok = (bs % 8 == 0 and d % 8 == 0
+                 and q.dtype == k_pages.dtype == v_pages.dtype)
+    impl = resolve_impl(implementation, pallas_ok=pallas_ok)
+    if impl == "xla" or not pallas_ok:
+        return paged_attention_reference(
+            q, k_pages, v_pages, block_tables, lengths, scale=scale)
+    return _run_paged(q, k_pages, v_pages,
+                      jnp.asarray(block_tables, jnp.int32),
+                      jnp.asarray(lengths, jnp.int32), scale,
+                      impl == "pallas_interpret")

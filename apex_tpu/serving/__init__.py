@@ -1,15 +1,24 @@
 """apex_tpu.serving — continuous-batching TPU inference engine.
 
 Multi-tenant serving over the model zoo's ``decode=True`` KV-cache
-path: a slotted cache pool with fixed ``max_slots × max_seq_len``
-shapes (:mod:`~apex_tpu.serving.cache`), one jitted decode step with
-per-slot device-array sampling params (:mod:`~apex_tpu.serving.engine`),
-a bounded FIFO queue with slot-level admission/eviction at step
-boundaries (:mod:`~apex_tpu.serving.scheduler`), and a threaded
-submit/stream front-end (:mod:`~apex_tpu.serving.api`).  Greedy decode
-through the engine is token-identical to
-``apex_tpu.models.generate``; steady state is retrace-free and
-*enforced* so by ``tracecheck.retrace_guard``.  See docs/serving.md.
+path, in two cache layouts:
+
+- **paged** (:class:`PagedEngine`, the hot path): a block-pool
+  KV-cache sized in TOKENS with per-slot block tables
+  (:mod:`~apex_tpu.serving.cache`), chunked prefill riding inside the
+  fused mixed prefill+decode step, token-budget admission and
+  block-exhaustion preemption — HBM footprint and per-step bytes
+  scale with live tokens, not ``max_slots × max_seq_len``;
+- **dense** (:class:`Engine`, the fallback): the fixed
+  ``max_slots × max_seq_len`` slotted slab with bucket-padded prefill.
+
+Plus a bounded FIFO queue with admission/eviction at step boundaries
+(:mod:`~apex_tpu.serving.scheduler`) and a threaded submit/stream
+front-end with TTFT / step-latency / pool-occupancy telemetry
+(:mod:`~apex_tpu.serving.api`).  Greedy decode through either engine
+is token-identical to ``apex_tpu.models.generate``; steady state is
+retrace-free and *enforced* so by ``tracecheck.retrace_guard``.  See
+docs/serving.md.
 """
 
 from apex_tpu.serving.api import (
@@ -18,7 +27,13 @@ from apex_tpu.serving.api import (
     RequestHandle,
     ServerClosed,
 )
-from apex_tpu.serving.engine import DEFAULT_BUCKETS, Engine
+from apex_tpu.serving.engine import (
+    DEFAULT_BUCKETS,
+    Engine,
+    PagedEngine,
+    StepOutput,
+)
+from apex_tpu.serving.cache import BlockAllocator, BlockExhausted
 from apex_tpu.serving.scheduler import (
     QueueFull,
     Request,
@@ -32,6 +47,10 @@ __all__ = [
     "RequestFailed",
     "ServerClosed",
     "Engine",
+    "PagedEngine",
+    "StepOutput",
+    "BlockAllocator",
+    "BlockExhausted",
     "DEFAULT_BUCKETS",
     "Scheduler",
     "Request",

@@ -23,12 +23,13 @@ from __future__ import annotations
 import queue as queue_mod
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from apex_tpu.resilience import faults
-from apex_tpu.serving.engine import DEFAULT_BUCKETS, Engine
+from apex_tpu.serving.engine import DEFAULT_BUCKETS, Engine, PagedEngine
 from apex_tpu.serving.scheduler import QueueFull, Request, Scheduler
 from apex_tpu.utils.metrics import MetricsWriter, counters
 
@@ -165,13 +166,36 @@ class InferenceServer:
     """
 
     def __init__(self, model, params, *, max_slots: int = 4,
-                 prompt_buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 prompt_buckets: Optional[Sequence[int]] = None,
                  prefill_chunk: int = 0, queue_capacity: int = 64,
                  metrics: Optional[MetricsWriter] = None,
-                 metrics_interval: int = 32):
-        self.engine = Engine(
-            model, params, max_slots=max_slots,
-            prompt_buckets=prompt_buckets, prefill_chunk=prefill_chunk)
+                 metrics_interval: int = 32,
+                 kv_cache: str = "dense", block_size: int = 0,
+                 pool_tokens: Optional[int] = None,
+                 admit_headroom: Optional[int] = None):
+        if kv_cache == "paged":
+            if prompt_buckets is not None:
+                raise ValueError(
+                    "prompt_buckets only applies to kv_cache='dense' "
+                    "— chunked prefill admits any prompt length; "
+                    "tune prefill_chunk (step width) and pool_tokens "
+                    "instead")
+            # chunked prefill needs a chunk width; 0 (the dense
+            # single-call convention) maps to the engine default
+            self.engine: Any = PagedEngine(
+                model, params, max_slots=max_slots,
+                block_size=block_size, pool_tokens=pool_tokens,
+                prefill_chunk=prefill_chunk or 32,
+                admit_headroom=admit_headroom)
+        elif kv_cache == "dense":
+            self.engine = Engine(
+                model, params, max_slots=max_slots,
+                prompt_buckets=(DEFAULT_BUCKETS if prompt_buckets
+                                is None else prompt_buckets),
+                prefill_chunk=prefill_chunk)
+        else:
+            raise ValueError(
+                f"kv_cache={kv_cache!r} not in ('dense', 'paged')")
         self.scheduler = Scheduler(self.engine,
                                    queue_capacity=queue_capacity)
         self.metrics = metrics
@@ -190,6 +214,11 @@ class InferenceServer:
         self._requeues = 0
         self._failed_requests = 0
         self._deadline_expired = 0
+        # latency telemetry: time-to-first-token per request and
+        # per-step decode wall time, bounded reservoirs (p50/p99 ride
+        # every metrics emission and the soak summary)
+        self._ttft: deque = deque(maxlen=2048)
+        self._step_times: deque = deque(maxlen=4096)
         #: the exception that killed the worker loop, if any — clients
         #: see ServerClosed; the root cause lives here for post-mortems
         self.error: Optional[BaseException] = None
@@ -303,7 +332,10 @@ class InferenceServer:
                     attempt = self._step_attempts
                     self._step_attempts += 1
                     faults.inject("serving.step", step=attempt)
+                    t_step0 = time.monotonic()
                     events = self.scheduler.run_step()
+                    self._step_times.append(
+                        time.monotonic() - t_step0)
                 except faults.TransientError as exc:
                     # a retryable step fault: the raiser guarantees
                     # engine state is intact (host-side failure, raised
@@ -326,6 +358,11 @@ class InferenceServer:
                 for ev in events:
                     self._tokens_emitted += 1
                     self._window_tokens += 1
+                    if len(ev.request.tokens) == 1:
+                        # first token of this request (requeued
+                        # continuations keep their prefix, so this
+                        # fires exactly once per request)
+                        self._ttft.append(now - ev.request.accepted_at)
                     handle = self._handles.get(id(ev.request))
                     if handle is not None:
                         handle._deliver(ev.token, ev.finished)
@@ -428,9 +465,29 @@ class InferenceServer:
                     f"request {req.uid} deadline ({req.deadline}s) "
                     f"expired after {len(req.tokens)} tokens"))
 
+    def latency_summary(self) -> Dict[str, float]:
+        """p50/p99 of time-to-first-token and per-step decode latency
+        over the bounded reservoirs (seconds / milliseconds) — the
+        soak-summary numbers; also folded into every metrics
+        emission."""
+        out: Dict[str, float] = {}
+        # snapshot first: the worker thread appends concurrently, and
+        # iterating a deque during an append raises RuntimeError
+        ttft_snap = list(self._ttft)
+        step_snap = list(self._step_times)
+        if ttft_snap:
+            ttft = np.asarray(ttft_snap, np.float64)
+            out["ttft_p50_s"] = float(np.percentile(ttft, 50))
+            out["ttft_p99_s"] = float(np.percentile(ttft, 99))
+        if step_snap:
+            st = np.asarray(step_snap, np.float64) * 1e3
+            out["step_ms_p50"] = float(np.percentile(st, 50))
+            out["step_ms_p99"] = float(np.percentile(st, 99))
+        return out
+
     def _emit_metrics(self, now: float) -> None:
         dt = max(now - (self._window_t0 or now), 1e-9)
-        self.metrics(self._steps, {
+        payload = {
             "tokens_per_sec": self._window_tokens / dt,
             "occupancy": self.scheduler.occupancy,
             "queue_depth": self.scheduler.queue_depth,
@@ -438,7 +495,15 @@ class InferenceServer:
             "requeues": self._requeues,
             "failed_requests": self._failed_requests,
             "deadline_expired": self._deadline_expired,
-        })
+            "preempts": self.scheduler.preempts,
+        }
+        payload.update(self.latency_summary())
+        blocks_total = getattr(self.engine, "blocks_total", None)
+        if blocks_total:
+            # pool occupancy gauge (paged engine): the overcommit dial
+            payload["blocks_in_use"] = self.engine.blocks_in_use
+            payload["blocks_total"] = blocks_total
+        self.metrics(self._steps, payload)
         self.metrics.drain()
         self._last_emit_step = self._steps
         self._window_tokens = 0
@@ -465,7 +530,7 @@ class InferenceServer:
             status = "stopped"
         else:
             status = "serving"
-        return {
+        out = {
             "status": status,
             "ready": status == "serving",
             "steps": self._steps,
@@ -475,8 +540,14 @@ class InferenceServer:
             "requeues": self._requeues,
             "failed_requests": self._failed_requests,
             "deadline_expired": self._deadline_expired,
+            "preempts": self.scheduler.preempts,
             "error": None if self.error is None else repr(self.error),
         }
+        blocks_total = getattr(self.engine, "blocks_total", None)
+        if blocks_total:
+            out["blocks_in_use"] = self.engine.blocks_in_use
+            out["blocks_total"] = blocks_total
+        return out
 
     # ---------------------------------------------------------- telemetry
     @property

@@ -25,7 +25,7 @@ rejects it loudly.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any, List, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +39,10 @@ __all__ = [
     "write_slot",
     "reset_slot",
     "rewind_index_leaves",
+    "BlockAllocator",
+    "BlockExhausted",
+    "blocks_for",
+    "set_paged_leaves",
 ]
 
 # cache leaves that hold *positions* rather than keys/values: the
@@ -199,3 +203,126 @@ def release_slot(state: SlotState, slot) -> SlotState:
 
 
 __all__ += ["admit_slot", "release_slot"]
+
+
+# --------------------------------------------------------------------- #
+# paged KV-cache: host-side block pool + device-leaf plumbing
+# --------------------------------------------------------------------- #
+# leaves of the PAGED cache tree the engine overwrites every step from
+# its host allocator (block_tables/cursors per layer; position_index at
+# the model level for learned-position models)
+_TABLE_LEAF = "block_tables"
+_CURSOR_LEAVES = ("cursors", "position_index")
+
+
+class BlockExhausted(RuntimeError):
+    """The paged KV pool has no free blocks left.
+
+    Raised by :meth:`BlockAllocator.alloc`; the engine's step loop
+    catches it and preempts a tenant (whose requeue continues from its
+    streamed prefix) instead of failing the step.
+    """
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Pages needed to hold ``tokens`` cache positions."""
+    return -(-int(tokens) // int(block_size))
+
+
+class BlockAllocator:
+    """Host-side free list over the physical page pool.
+
+    The pool is sized in TOKENS (``num_blocks × block_size``), shared
+    by every tenant — the paged tentpole's replacement for the dense
+    ``max_slots × max_seq_len`` reservation.  Physical block 0 is the
+    reserved **null page**: unallocated block-table entries point at
+    it, pad-token writes land in it, and the position mask keeps its
+    contents unreachable — so it is never handed out.
+
+    Not thread-safe: the engine-owning thread is the only caller (the
+    same single-writer discipline as the engine itself).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {block_size}")
+        if num_blocks < 2:
+            raise ValueError(
+                "num_blocks must be >= 2 (block 0 is the reserved "
+                f"null page), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO free stack: blocks freed together are reused together
+        # (keeps a tenant's pages warm in any downstream cache level)
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def blocks_total(self) -> int:
+        """Allocatable pages (the null page is not allocatable)."""
+        return self.num_blocks - 1
+
+    @property
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.blocks_total - len(self._free)
+
+    @property
+    def tokens_total(self) -> int:
+        return self.blocks_total * self.block_size
+
+    @property
+    def tokens_free(self) -> int:
+        return len(self._free) * self.block_size
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` pages; raises :class:`BlockExhausted` (taking
+        none) when fewer than ``n`` are free — allocation is atomic so
+        a failed extension never leaks partial pages."""
+        if n < 0:
+            raise ValueError(f"n must be >= 0, got {n}")
+        if n > len(self._free):
+            raise BlockExhausted(
+                f"need {n} blocks, {len(self._free)} free "
+                f"(pool: {self.blocks_total} × {self.block_size} tok)")
+        taken = self._free[-n:] if n else []
+        del self._free[len(self._free) - n:]
+        return taken
+
+    def free(self, blocks) -> None:
+        """Return pages to the pool (idempotence is NOT provided —
+        double-free is a caller bug and raises)."""
+        for blk in blocks:
+            blk = int(blk)
+            if not 1 <= blk < self.num_blocks:
+                raise ValueError(
+                    f"block {blk} outside the allocatable range "
+                    f"[1, {self.num_blocks})")
+            if blk in self._free:
+                raise ValueError(f"double free of block {blk}")
+            self._free.append(blk)
+
+
+def set_paged_leaves(cache: Any, tables, cursors) -> Any:
+    """Overwrite the paged cache tree's ``block_tables`` and cursor
+    leaves (``cursors`` / ``position_index``) with the engine's
+    host-authoritative values, broadcast to each leaf's shape (the
+    scanned layer stack adds a leading layer axis — every layer shares
+    one logical→physical mapping because the per-layer pools are
+    parallel).  K/V pool leaves pass through untouched.
+    """
+    tables = jnp.asarray(tables, jnp.int32)
+    cursors = jnp.asarray(cursors, jnp.int32)
+
+    def fix(path, leaf):
+        name = _leaf_name(path)
+        if name == _TABLE_LEAF:
+            return jnp.broadcast_to(tables, leaf.shape).astype(leaf.dtype)
+        if name in _CURSOR_LEAVES:
+            return jnp.broadcast_to(cursors, leaf.shape).astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)

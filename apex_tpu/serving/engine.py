@@ -41,7 +41,8 @@ fully rebuilt at the next admission.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+import dataclasses
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -56,9 +57,41 @@ from apex_tpu.models.generate import (
 from apex_tpu.serving import cache as slot_cache
 from apex_tpu.utils import tracecheck
 
-__all__ = ["Engine", "sample_dynamic", "DEFAULT_BUCKETS"]
+__all__ = ["Engine", "PagedEngine", "StepOutput", "sample_dynamic",
+           "DEFAULT_BUCKETS"]
 
 DEFAULT_BUCKETS: Tuple[int, ...] = (32, 128, 512)
+
+
+class StepOutput(NamedTuple):
+    """One engine step's host-visible result.
+
+    ``tokens``/``finished`` are length-``max_slots`` numpy arrays as in
+    the dense engine; ``emitted[i]`` marks slots whose token is REAL
+    this step (a mid-prefill tenant computes but emits nothing);
+    ``preempted`` lists slots the engine evicted for block exhaustion
+    before the step ran — their tenants' blocks and slot state are
+    already released, and the scheduler requeues them to continue from
+    their streamed prefix.
+    """
+
+    tokens: np.ndarray
+    finished: np.ndarray
+    emitted: np.ndarray
+    preempted: Tuple[int, ...]
+
+
+def _check_sampling(vocab_size: int, top_k, top_p) -> None:
+    """Shared sampling-parameter validation (dense + paged engines)."""
+    if top_k is not None and top_k != 0 \
+            and not 1 <= top_k <= vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={vocab_size}] "
+            f"(or 0/None to disable), got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"top_p must be in (0, 1] (or None to disable), "
+            f"got {top_p}")
 
 
 def sample_dynamic(logits, keys, temperature, top_k, top_p,
@@ -129,6 +162,9 @@ class Engine:
     compile count is ``len(buckets) + 3`` for the process lifetime.
     """
 
+    #: dense slab layout — :class:`PagedEngine` is the paged twin
+    paged = False
+
     def __init__(self, model, params, *, max_slots: int = 4,
                  prompt_buckets: Sequence[int] = DEFAULT_BUCKETS,
                  prefill_chunk: int = 0):
@@ -140,6 +176,12 @@ class Engine:
         if not getattr(cfg, "causal", True):
             raise ValueError("Engine requires a causal model "
                              "(decode=True contract)")
+        if getattr(cfg, "kv_cache", "dense") == "paged":
+            raise ValueError(
+                "this model is configured for the paged KV-cache "
+                "(cfg.kv_cache='paged') — serve it through "
+                "PagedEngine, or pass the dense twin (the engines "
+                "build their own layout twin from cfg)")
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if prefill_chunk < 0:
@@ -271,17 +313,16 @@ class Engine:
                 f"prompt_len ({prompt_len}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_seq_len "
                 f"({self.max_seq_len})")
-        if top_k is not None and top_k != 0 \
-                and not 1 <= top_k <= self.vocab_size:
-            raise ValueError(
-                f"top_k must be in [1, vocab_size={self.vocab_size}] "
-                f"(or 0/None to disable), got {top_k}")
-        if top_p is not None and not 0.0 < top_p <= 1.0:
-            raise ValueError(
-                f"top_p must be in (0, 1] (or None to disable), "
-                f"got {top_p}")
+        _check_sampling(self.vocab_size, top_k, top_p)
         del temperature      # any float is admissible (<=0 -> greedy)
         return bucket
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Dense pool: the slab reserves worst-case room per slot, so
+        a free slot is always admissible (the scheduler gates on slot
+        availability; the paged engine gates on free blocks here)."""
+        del prompt_len, max_new_tokens
+        return True
 
     def admit(self, slot: int, prompt, *, max_new_tokens: int,
               temperature: float = 0.0, top_k: Optional[int] = None,
@@ -344,6 +385,423 @@ class Engine:
         return {
             "decode_step": self._step.trace_count,
             "prefill": self._prefill.trace_count,
+            "admit": self._admit.trace_count,
+            "release": self._release.trace_count,
+        }
+
+
+# --------------------------------------------------------------------- #
+# paged engine — token-granular serving datapath
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass
+class _Tenant:
+    """Host-side record of one slot's tenant (the device never sees
+    prompts or block lists — only the tables/cursors built from them)."""
+
+    prompt: np.ndarray          # full prompt tokens
+    fed: int = 0                # prompt tokens already fed (chunked)
+    cursor: int = 0             # tokens written into the cache
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    seq: int = 0                # admission order (LIFO preemption key)
+
+
+class PagedEngine:
+    """Continuous-batching decode over a PAGED KV-cache pool.
+
+    The dense :class:`Engine` reserves a ``max_slots × max_seq_len``
+    K/V slab and admits via bucket-padded whole-prompt prefill.  This
+    engine instead:
+
+    - stores K/V in fixed-size **pages** of a pool sized in TOKENS
+      (``pool_tokens``), shared across tenants through per-slot block
+      tables (:class:`~apex_tpu.serving.cache.BlockAllocator`) — HBM
+      footprint and per-step attention bytes scale with live tokens,
+      so the same budget holds several times the dense slot count;
+    - runs **chunked prefill inside the decode step**: prompts are
+      split into ``prefill_chunk``-token pieces that ride the regular
+      step beside decoding tenants (ONE fused mixed prefill+decode
+      executable), so a long prompt can never head-of-line-block
+      co-tenants and per-step latency is bounded by the chunk;
+    - the whole ragged batch is ONE model application — per-row
+      cursors/block tables in the cache collection replace the dense
+      engine's per-slot vmap, and attention goes through
+      :func:`apex_tpu.ops.paged_attention`.
+
+    Exactly FOUR executables for the process lifetime, each under an
+    exact :func:`~apex_tpu.utils.tracecheck.retrace_guard` budget of 1:
+    ``decode_step`` (width-1 step), ``prefill_step`` (the width-
+    ``prefill_chunk`` mixed step — the dense engine's per-bucket
+    prefills collapse to this one shape), ``admit`` (slot-state
+    scatter; no cache writes — pages are overwritten before they become
+    visible, so admission and release never touch the pool), and
+    ``release``.
+
+    Block exhaustion preempts the YOUNGEST tenant (its blocks are
+    freed, its slot state cleared) and reports it in
+    ``StepOutput.preempted``; the scheduler requeues it to continue
+    from its streamed prefix (PR 4's fault-recovery machinery).
+
+    ``block_size=0`` consults the
+    :mod:`~apex_tpu.ops.autotune` table (op ``"paged_attention"``,
+    keyed on head_dim/dtype) and falls back to 16.  ``pool_tokens``
+    defaults to ``max_slots × max_seq_len`` — the dense slab's
+    footprint; shrink it to trade capacity for memory (admission
+    token-gates and preemption backstops the overcommit).
+    """
+
+    paged = True
+
+    def __init__(self, model, params, *, max_slots: int = 4,
+                 block_size: int = 0,
+                 pool_tokens: Optional[int] = None,
+                 prefill_chunk: int = 32,
+                 admit_headroom: Optional[int] = None):
+        cfg = getattr(model, "cfg", None)
+        if cfg is None or not hasattr(cfg, "max_seq_len"):
+            raise ValueError(
+                "PagedEngine needs a model with a .cfg carrying "
+                "max_seq_len and vocab_size (GPTModel / LlamaModel "
+                "contract)")
+        if not getattr(cfg, "causal", True):
+            raise ValueError("PagedEngine requires a causal model "
+                             "(decode=True contract)")
+        if getattr(cfg, "sliding_window", None) is not None:
+            raise ValueError(
+                "PagedEngine does not support sliding-window models — "
+                "the paged pool already bounds decode memory to live "
+                "tokens; serve with sliding_window=None")
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(cfg.max_seq_len)
+        self.vocab_size = int(cfg.vocab_size)
+        self._chunk = int(prefill_chunk)
+        if block_size == 0:
+            from apex_tpu.ops import autotune
+            block_size = autotune.cached_block_rows(
+                "paged_attention", int(cfg.head_dim),
+                str(jnp.dtype(cfg.dtype))) or 16
+        if block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {block_size}")
+        self.block_size = int(block_size)
+        if pool_tokens is None:
+            pool_tokens = self.max_slots * self.max_seq_len
+        # the pool bounds the largest ADMISSIBLE request
+        # (validate_request rejects anything that could never fit
+        # alone); the floor here only covers the warmup tenant
+        min_tokens = min(self._chunk + 3, self.max_seq_len)
+        if pool_tokens < min_tokens:
+            raise ValueError(
+                f"pool_tokens ({pool_tokens}) must cover at least the "
+                f"warmup tenant ({min_tokens} tokens)")
+        num_blocks = slot_cache.blocks_for(pool_tokens,
+                                           self.block_size) + 1
+        self._alloc = slot_cache.BlockAllocator(num_blocks,
+                                                self.block_size)
+        self._headroom = (2 * self.block_size if admit_headroom is None
+                          else int(admit_headroom))
+        self._variables = dict(params)
+        if "cache" in self._variables:
+            raise ValueError(
+                "params must not carry a 'cache' collection — the "
+                "engine owns the cache pool")
+        # the paged twin: same parameters, paged cache layout — the
+        # layout is part of the module hash, so its executables can
+        # never collide with a dense model's in any jit cache
+        self._paged_model = type(model)(cfg=dataclasses.replace(
+            cfg, kv_cache="paged", kv_block_size=self.block_size,
+            kv_pool_blocks=num_blocks))
+        shapes = cache_shapes(self._paged_model, self.max_slots)
+        self.cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        self.state = slot_cache.init_slot_state(self.max_slots)
+        mb = slot_cache.blocks_for(self.max_seq_len, self.block_size)
+        self._tables = np.zeros((self.max_slots, mb), np.int32)
+        self._cursors = np.zeros((self.max_slots,), np.int32)
+        self._tenants: List[Optional[_Tenant]] = [None] * self.max_slots
+        self._admit_seq = 0
+        self._build()
+
+    # ------------------------------------------------------------- jits
+    def _build(self) -> None:
+        model = self._paged_model
+        vocab = self.vocab_size
+
+        def step_fn(variables, cache, state, tables, cursors, feed,
+                    n_tokens, is_prefill, emit):
+            # the host-authoritative block tables / cursors overwrite
+            # their cache leaves (the model never advances them)
+            cache = slot_cache.set_paged_leaves(cache, tables, cursors)
+            # one ragged-batch application: prefilling rows feed their
+            # chunk, decoding rows their last sampled token (+ pad)
+            tok_ids = jnp.zeros_like(feed).at[:, 0].set(state.tok)
+            ids = jnp.where(is_prefill[:, None], feed, tok_ids)
+            logits, cache = apply_decode(model, variables, cache, ids)
+            last = jnp.take_along_axis(
+                logits, (n_tokens - 1)[:, None, None], axis=1)[:, 0]
+            split = jax.vmap(jax.random.split)(state.rng)
+            nxt = sample_dynamic(last, split[:, 0], state.temperature,
+                                 state.top_k, state.top_p, vocab)
+            # emission is gated on the host plan: a mid-prefill tenant
+            # computes but emits nothing, and its rng does NOT advance
+            # — the k-th produced token always uses the k-th split, so
+            # sampled chains are invariant to chunking
+            emit = emit & state.active
+            produced = state.produced + emit.astype(jnp.int32)
+            hit_budget = produced >= state.budget
+            hit_eos = (state.eos_id >= 0) & (nxt == state.eos_id)
+            finished = emit & (hit_budget | hit_eos)
+            state = state._replace(
+                tok=jnp.where(emit, nxt, state.tok),
+                produced=produced,
+                active=state.active & ~finished,
+                rng=jnp.where(emit[:, None], split[:, 1], state.rng))
+            return cache, state, nxt, finished
+
+        def admit(state, slot, tok, budget, temperature, top_k, top_p,
+                  eos_id, seed):
+            return slot_cache.admit_slot(
+                state, slot, tok, budget, temperature, top_k, top_p,
+                eos_id, seed)
+
+        def release(state, slot):
+            return slot_cache.release_slot(state, slot)
+
+        # exact budgets: decode/admit/release = 1 and the dense
+        # engine's per-bucket prefills collapse to ONE mixed-step
+        # shape — any excess trace raises RetraceError
+        self._decode = tracecheck.retrace_guard(
+            step_fn, max_traces=1, name="serving.decode_step",
+            donate_argnums=(1, 2))
+        self._prefill = tracecheck.retrace_guard(
+            step_fn, max_traces=1, name="serving.prefill_step",
+            donate_argnums=(1, 2))
+        self._admit = tracecheck.retrace_guard(
+            admit, max_traces=1, name="serving.admit",
+            donate_argnums=(0,))
+        self._release = tracecheck.retrace_guard(
+            release, max_traces=1, name="serving.release",
+            donate_argnums=(0,))
+
+    # ------------------------------------------------------------- host
+    def validate_request(self, prompt_len: int, max_new_tokens: int,
+                         temperature: float = 0.0,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None) -> None:
+        """Static admission checks (no buckets: chunked prefill admits
+        any prompt length that fits the cache and the pool)."""
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt_len + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt_len ({prompt_len}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({self.max_seq_len})")
+        need = slot_cache.blocks_for(prompt_len + max_new_tokens,
+                                     self.block_size)
+        if need > self._alloc.blocks_total:
+            raise ValueError(
+                f"request needs {need} pages "
+                f"({prompt_len}+{max_new_tokens} tokens at "
+                f"block_size={self.block_size}) but the whole pool "
+                f"holds {self._alloc.blocks_total} — raise pool_tokens")
+        _check_sampling(self.vocab_size, top_k, top_p)
+        del temperature
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Token-budget admission gate: free pages must cover the
+        prompt plus reserved decode headroom (preemption backstops the
+        deliberate overcommit beyond the headroom)."""
+        need = slot_cache.blocks_for(
+            prompt_len + min(int(max_new_tokens), self._headroom),
+            self.block_size)
+        return self._alloc.blocks_free >= need
+
+    def admit(self, slot: int, prompt, *, max_new_tokens: int,
+              temperature: float = 0.0, top_k: Optional[int] = None,
+              top_p: Optional[float] = None,
+              eos_id: Optional[int] = None, seed: int = 0) -> None:
+        """Install one request into a free slot.  NO prefill happens
+        here — the prompt rides the next steps as chunks; no pages are
+        allocated either (the step loop extends tables just ahead of
+        the tokens it writes)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.validate_request(prompt.shape[0], max_new_tokens,
+                              temperature, top_k, top_p)
+        if not 0 <= slot < self.max_slots:
+            raise ValueError(
+                f"slot must be in [0, {self.max_slots}), got {slot}")
+        if self._tenants[slot] is not None:
+            raise ValueError(f"slot {slot} is occupied (paged "
+                             "admission never silently replaces — the "
+                             "tenant owns pool pages)")
+        self._admit_seq += 1
+        self._tenants[slot] = _Tenant(prompt=prompt,
+                                      seq=self._admit_seq)
+        self.state = self._admit(
+            self.state, np.int32(slot), np.int32(prompt[-1]),
+            np.int32(max_new_tokens), np.float32(temperature),
+            np.int32(top_k or 0),
+            np.float32(0.0 if top_p is None else top_p),
+            np.int32(-1 if eos_id is None else eos_id),
+            np.uint32(seed))
+
+    def _youngest(self) -> int:
+        live = [s for s, t in enumerate(self._tenants) if t is not None]
+        return max(live, key=lambda s: self._tenants[s].seq)
+
+    def _free_tenant(self, slot: int) -> None:
+        """Return a tenant's pages and clear its host/device state.
+        The pool itself is untouched: freed pages are garbage until
+        their next owner overwrites them, and the position mask keeps
+        garbage unreachable."""
+        rec = self._tenants[slot]
+        if rec is not None:
+            self._alloc.free(rec.blocks)
+            self._tables[slot] = 0
+            self._cursors[slot] = 0
+            self._tenants[slot] = None
+        self.state = self._release(self.state, np.int32(slot))
+
+    def _extend(self, slot: int, n: int,
+                preempted: List[int]) -> None:
+        """Grow ``slot``'s block table to cover its next ``n`` real
+        tokens, preempting the youngest tenant on exhaustion.  A
+        request is admission-validated to fit the whole pool alone, so
+        the loop terminates: in the worst case everyone else (and
+        finally the needy slot itself) is preempted."""
+        rec = self._tenants[slot]
+        while rec is not None:
+            # capped at the table width: a finished-but-unreleased
+            # tenant stepped past max_seq_len (possible in raw engine
+            # drivers; the scheduler releases at the finish boundary)
+            # wraps within its last page instead of growing the table
+            need = min(slot_cache.blocks_for(rec.cursor + n,
+                                             self.block_size),
+                       self._tables.shape[1]) - len(rec.blocks)
+            if need <= 0:
+                return
+            try:
+                got = self._alloc.alloc(need)
+            except slot_cache.BlockExhausted:
+                victim = self._youngest()
+                self._free_tenant(victim)
+                preempted.append(victim)
+                if victim == slot:
+                    return
+                continue
+            start = len(rec.blocks)
+            self._tables[slot, start:start + len(got)] = got
+            rec.blocks.extend(got)
+
+    def step(self) -> StepOutput:
+        """One fused mixed prefill+decode step over every slot.
+
+        Prefilling tenants consume their next prompt chunk (emitting a
+        token only on the final chunk — that token IS the first
+        generated one, sampled straight from the prefill logits);
+        decoding tenants advance one token.  Inactive slots compute
+        garbage into the null page.  The single per-step host sync
+        lives here.
+        """
+        w = 1
+        for rec in self._tenants:
+            if rec is not None and rec.fed < rec.prompt.size:
+                w = self._chunk
+                break
+        any_prefill = w == self._chunk
+        feed = np.zeros((self.max_slots, w), np.int32)
+        n_tokens = np.ones((self.max_slots,), np.int32)
+        is_prefill = np.zeros((self.max_slots,), bool)
+        emit = np.zeros((self.max_slots,), bool)
+        preempted: List[int] = []
+        for slot in range(self.max_slots):
+            rec = self._tenants[slot]
+            if rec is None:
+                continue
+            if rec.fed < rec.prompt.size:
+                n = min(w, rec.prompt.size - rec.fed)
+                feed[slot, :n] = rec.prompt[rec.fed:rec.fed + n]
+                n_tokens[slot] = n
+                is_prefill[slot] = True
+                emit[slot] = rec.fed + n >= rec.prompt.size
+            else:
+                emit[slot] = True
+            self._extend(slot, int(n_tokens[slot]), preempted)
+        for slot in preempted:
+            feed[slot] = 0
+            n_tokens[slot] = 1
+            is_prefill[slot] = False
+            emit[slot] = False
+        runner = self._prefill if any_prefill else self._decode
+        self.cache, self.state, toks, finished = runner(
+            self._variables, self.cache, self.state, self._tables,
+            self._cursors, feed, n_tokens, is_prefill, emit)
+        for slot in range(self.max_slots):
+            rec = self._tenants[slot]
+            if rec is None:
+                continue
+            n = int(n_tokens[slot])
+            if is_prefill[slot]:
+                rec.fed += n
+            rec.cursor += n
+            self._cursors[slot] = rec.cursor
+        return StepOutput(np.asarray(toks), np.asarray(finished),
+                          emit, tuple(preempted))
+
+    def release(self, slot: int) -> None:
+        """Free ``slot``: pages back to the pool, state cleared."""
+        self._free_tenant(slot)
+
+    def warmup(self) -> None:
+        """Trace all four executables: one dummy tenant whose prompt
+        spans a full chunk plus a remainder (mixed prefill step), then
+        one pure decode step.  Steady state over ANY request mix is
+        retrace-free afterwards — and guarded.
+
+        The prompt clamps to ``max_seq_len - 2`` for small-context
+        models (chunk width larger than the context is legal: real
+        chunks are capped by the prompt; the executable widths traced
+        are the same either way)."""
+        plen = min(self._chunk + 1, self.max_seq_len - 2)
+        self.admit(0, np.zeros((plen,), np.int32), max_new_tokens=2)
+        while self._tenants[0] is not None:
+            out = self.step()
+            if bool(out.finished[0]):
+                break
+        self.release(0)
+
+    # ------------------------------------------------------------ gauges
+    @property
+    def blocks_total(self) -> int:
+        return self._alloc.blocks_total
+
+    @property
+    def blocks_free(self) -> int:
+        return self._alloc.blocks_free
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self._alloc.blocks_in_use
+
+    @property
+    def pool_tokens(self) -> int:
+        return self._alloc.tokens_total
+
+    @property
+    def trace_counts(self) -> dict:
+        """Observed traces per executable (diagnostics / tests)."""
+        return {
+            "decode_step": self._decode.trace_count,
+            "prefill_step": self._prefill.trace_count,
             "admit": self._admit.trace_count,
             "release": self._release.trace_count,
         }

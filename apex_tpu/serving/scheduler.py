@@ -28,6 +28,7 @@ from typing import Deque, List, Optional, Tuple
 import numpy as np
 
 from apex_tpu.resilience import faults
+from apex_tpu.serving.engine import StepOutput
 from apex_tpu.utils.metrics import counters
 
 __all__ = ["Request", "Scheduler", "QueueFull", "StepEvent"]
@@ -95,6 +96,8 @@ class Scheduler:
         # never read back outside step()
         self._slots: List[Optional[Request]] = [None] * engine.max_slots
         self._admit_failures: List[Tuple[Request, BaseException]] = []
+        #: block-exhaustion preemptions requeued so far (paged engine)
+        self.preempts = 0
 
     # ------------------------------------------------------------ intake
     def submit(self, request: Request) -> Request:
@@ -192,6 +195,13 @@ class Scheduler:
         queue's front once, then recorded terminally on
         ``take_admit_failures`` — either way the other tenants keep
         decoding.  Any other exception propagates (fatal, as before).
+
+        Admission is TOKEN-gated, not just slot-gated: the engine's
+        ``can_admit`` must also clear the queue head (the paged engine
+        requires free pages to cover prompt + decode headroom; the
+        dense engine always says yes).  The check stays FIFO — a
+        too-big head blocks the queue rather than being overtaken,
+        so admission order cannot starve large requests.
         """
         admitted = 0
         for slot, occupant in enumerate(self._slots):
@@ -199,6 +209,11 @@ class Scheduler:
                 continue
             with self._lock:
                 if not self._queue:
+                    break
+                head = self._queue[0]
+                if not self.engine.can_admit(head.prompt.shape[0],
+                                             head.max_new_tokens):
+                    counters.inc("serving.admit_blocked")
                     break
                 req = self._queue.popleft()
             try:
@@ -248,14 +263,41 @@ class Scheduler:
 
         Returns the tokens produced this step (empty when idle).  Call
         from the engine-owning thread only.
+
+        Paged engines return a :class:`~apex_tpu.serving.engine.
+        StepOutput`: only ``emitted`` slots route a token (mid-prefill
+        tenants compute but emit nothing), and ``preempted`` tenants —
+        evicted by the engine for block exhaustion, pages already
+        freed — are requeued at the FRONT to continue from their
+        streamed prefix (the PR-4 fault-recovery machinery, but
+        without spending the request's transient-fault retry budget:
+        preemption is scheduling, not failure).
         """
         self._admit_from_queue()
         if self.active_count == 0:
             return []
-        tokens, finished = self.engine.step()
+        out = self.engine.step()
+        if isinstance(out, StepOutput):
+            tokens, finished, emitted, preempted = out
+        else:
+            tokens, finished = out
+            emitted, preempted = None, ()
+        for slot in preempted:
+            req = self._slots[slot]
+            if req is None:
+                continue
+            self._slots[slot] = None    # engine already freed the slot
+            self.preempts += 1
+            counters.inc("serving.preempt")
+            try:
+                self.requeue(req)
+            except ValueError as exc:   # unresumable continuation
+                self._admit_failures.append((req, exc))
         events: List[StepEvent] = []
         for slot, req in enumerate(self._slots):
             if req is None:
+                continue
+            if emitted is not None and not bool(emitted[slot]):
                 continue
             tok = int(tokens[slot])
             fin = bool(finished[slot])

@@ -1655,6 +1655,48 @@ def _long_context_single():
 
 # ---------------------------------------------------------------- serving
 
+def _serving_traffic_model(*, num_layers, kv_heads, head_dim,
+                           max_seq_len, live_tokens, slots,
+                           block_size, dtype_bytes=2):
+    """Analytic per-step KV-cache traffic of the serving decode step —
+    the measured defect behind the ISSUE-5 paged tentpole, in bytes:
+
+    - **dense** (``serving.Engine``): the slab reserves
+      ``slots × max_seq_len`` tokens of K+V per layer
+      (``dense_pool_bytes``), and the steady-decode attention reads a
+      whole ``max_seq_len`` row per slot per step — the cursor only
+      *masks*, it does not shrink the read
+      (``models/transformer.py::_cache_attention``; the ``blocked``
+      variant cond-skips dead pages at runtime but the reservation,
+      and the einsum default's reads, are pinned at ``max_seq_len``).
+      ``dense_kv_read_bytes_per_step`` is therefore LIVE-INDEPENDENT
+      — asserted so by ``tests/test_paged_attention.py``'s
+      cost-analysis check.
+    - **paged** (``serving.PagedEngine``): the pool is sized in TOKENS
+      (``paged_pool_tokens``; block 0 is the null page) and the decode
+      kernel gathers exactly ``ceil(live/block_size)`` pages per slot
+      per step — ``paged_kv_read_bytes_per_step`` scales with live
+      tokens, which is what lets the same HBM budget hold 2–4× the
+      dense slot count in the occupancy sweep below.
+
+    Both counts are K+V (×2) across all layers; the param stream
+    (identical for both engines) is excluded — this model isolates the
+    cache term the tentpole changes.
+    """
+    per_tok = 2 * kv_heads * head_dim * dtype_bytes * num_layers
+    live_pages = -(-int(live_tokens) // int(block_size))
+    return {
+        "dense_kv_read_bytes_per_step":
+            int(slots * max_seq_len * per_tok),
+        "paged_kv_read_bytes_per_step":
+            int(slots * live_pages * block_size * per_tok),
+        "dense_pool_bytes": int(slots * max_seq_len * per_tok),
+        "paged_pool_tokens": int(slots * max_seq_len),
+        "live_tokens": int(live_tokens),
+        "block_size": int(block_size),
+    }
+
+
 def bench_serving_decode():
     """Continuous-batching engine scoreboard (ISSUE 2): steady-state
     tokens/sec of ``apex_tpu.serving`` at FIXED slot occupancy on the
@@ -1759,6 +1801,105 @@ def bench_serving_decode():
                  "(token routing); generate() loops on-device — the "
                  "speedup is net of that overhead"),
     })
+
+    # -------- paged A/B + occupancy sweep (ISSUE 5 acceptance) --------
+    # equal HBM budget = the dense slab just measured (slots × S
+    # tokens of K/V per layer).  The A/B row (mult=1) answers "same
+    # slot count, paged layout: how much does the per-step gather
+    # cost?" (target: tokens/s per slot within 10% of dense); the
+    # sweep rows hold 2× and 4× the slot count in the SAME budget —
+    # possible only because live tokens/slot ≈ prompt + generated
+    # « max_seq_len, exactly the overcommit the dense slab forbids.
+    from apex_tpu.serving import PagedEngine
+
+    del engine                      # free the dense slab first
+    pool_tokens = slots * S
+    block = int(os.environ.get("BENCH_PAGED_BLOCK", "16"))
+    # +2 decode headroom beyond the measurement, capped so
+    # prompt + budget never exceeds max_seq_len when the room cap
+    # already pinned total_steps at its edge
+    paged_budget = min(total_steps + 2, S - P)
+    live = P + paged_budget
+    kv_bytes = 2 if cfg.dtype == jnp.bfloat16 else 4
+    paged_base_tps = None
+    live_pages = -(-live // block)
+    total_pages = -(-pool_tokens // block)
+    for mult in (1, 2, 4):
+        pslots = slots * mult
+        if pslots * live_pages > total_pages:
+            # capacity counted in PAGES (per-slot ceil rounding —
+            # token arithmetic under-counts near the edge and would
+            # let mid-window preemption silently shrink the
+            # measurement): record the bound instead
+            _emit({
+                "metric": (f"serving_decode_paged_x{mult}_"
+                           f"s{pslots}_S{S}_tokens_per_sec"),
+                "value": None,
+                "skipped": (f"{pslots} slots × {live_pages} live "
+                            f"pages exceed the {total_pages}-page "
+                            f"pool"),
+            })
+            continue
+        pengine = PagedEngine(model, params, max_slots=pslots,
+                              block_size=block,
+                              pool_tokens=pool_tokens,
+                              prefill_chunk=min(P, 128))
+        pengine.warmup()
+        pprompts = rng.integers(0, cfg.vocab_size,
+                                size=(pslots, P)).astype(np.int32)
+        for slot in range(pslots):
+            pengine.admit(slot, pprompts[slot],
+                          max_new_tokens=paged_budget)
+        # chunked prefill to completion, then one warm decode step
+        while any(t is not None and t.fed < P
+                  for t in pengine._tenants):
+            pengine.step()
+        pengine.step()
+        occupancy_blocks = pengine.blocks_in_use / pengine.blocks_total
+
+        def paged_window():
+            t0 = time.perf_counter()
+            for _ in range(N):
+                pengine.step()
+            return (time.perf_counter() - t0 - ovh) / N
+
+        t_paged, paged_w = bench._time_windows(paged_window, k_windows)
+        paged_tps = pslots / t_paged
+        per_slot = paged_tps / pslots
+        if mult == 1:
+            paged_base_tps = paged_tps
+        tm = _serving_traffic_model(
+            num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
+            head_dim=cfg.head_dim, max_seq_len=S, live_tokens=live,
+            slots=pslots, block_size=pengine.block_size,
+            dtype_bytes=kv_bytes)
+        row = {
+            "metric": (f"serving_decode_paged_x{mult}_s{pslots}_S{S}"
+                       f"_tokens_per_sec"),
+            "value": round(paged_tps, 1),
+            "unit": "tokens/sec/chip",
+            "slots": pslots, "max_seq_len": S, "prompt": P,
+            "block_size": pengine.block_size,
+            "pool_tokens": pool_tokens,
+            "hbm_budget": f"= dense slab at {slots} slots",
+            "occupancy_blocks": round(occupancy_blocks, 3),
+            "step_ms": round(t_paged * 1e3, 3),
+            "step_window_ms": [round(d * 1e3, 2) for d in paged_w],
+            "tokens_per_sec_per_slot": round(per_slot, 2),
+            "dense_tokens_per_sec_per_slot":
+                round(serving_tps / slots, 2),
+            "per_slot_vs_dense":
+                round(per_slot / (serving_tps / slots), 3),
+            "analytic_kv_traffic": tm,
+            "trace_counts": pengine.trace_counts,
+        }
+        if mult > 1 and paged_base_tps is not None:
+            row["tps_vs_paged_x1"] = round(
+                paged_tps / paged_base_tps, 2)
+        for slot in range(pslots):
+            pengine.release(slot)
+        _emit(row)
+        del pengine
 
 
 # ----------------------------------------------------------------- decode

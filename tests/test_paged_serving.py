@@ -1,0 +1,458 @@
+"""Paged serving datapath (apex_tpu.serving.PagedEngine).
+
+Correctness contracts under test:
+
+- greedy decode through the paged engine is TOKEN-IDENTICAL to
+  ``generate()`` for prompt lengths straddling every boundary that
+  matters (page size, chunk size, and their multiples);
+- a steady-state soak of mixed chunked-prefill + decode traffic with
+  heterogeneous sampling params triggers ZERO retraces after warmup at
+  the EXACT documented budget — decode_step/prefill_step/admit/release
+  = 1 each (the dense engine's per-bucket prefills collapse to one
+  mixed-step shape);
+- the block allocator: fragmentation-tolerant reuse, atomic
+  exhaustion, double-free detection, the reserved null page;
+- token-budget admission (free pages must cover prompt + headroom)
+  and block-exhaustion preemption that requeues the evicted tenant to
+  continue from its streamed prefix — with the greedy chain still
+  token-identical end to end;
+- eviction releases pages (deadline/fault paths reuse the same
+  release), sampled chains are a function of the request's own seed,
+  and the server surfaces TTFT / step-latency percentiles and the
+  blocks-occupancy gauge.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import GPTConfig, GPTModel, LlamaConfig, LlamaModel, generate
+from apex_tpu.serving import (
+    BlockAllocator,
+    BlockExhausted,
+    InferenceServer,
+    PagedEngine,
+    Request,
+    Scheduler,
+)
+from apex_tpu.serving import cache as slot_cache
+from apex_tpu.utils import MetricsWriter, tracecheck
+
+
+def _tiny_gpt():
+    cfg = GPTConfig.tiny(position_embedding="learned",
+                         scan_layers=True)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, {"params": params["params"]}
+
+
+def _tiny_llama():
+    cfg = LlamaConfig.tiny(scan_layers=True)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, {"params": params["params"]}
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    return _tiny_gpt()
+
+
+@pytest.fixture(scope="module")
+def llama():
+    return _tiny_llama()
+
+
+class TestBlockAllocator:
+    def test_null_page_reserved_and_sizes(self):
+        alloc = BlockAllocator(9, 4)
+        assert alloc.blocks_total == 8
+        assert alloc.tokens_total == 32
+        got = alloc.alloc(8)
+        assert 0 not in got and len(set(got)) == 8
+        assert alloc.blocks_free == 0
+
+    def test_fragmented_interleave_reuses_everything(self):
+        """Interleaved alloc/free in awkward sizes: a paged pool has
+        no fragmentation — any n <= free succeeds regardless of WHICH
+        pages were returned."""
+        alloc = BlockAllocator(17, 8)
+        a = alloc.alloc(5)
+        b = alloc.alloc(7)
+        alloc.free(a[1:4])          # punch holes
+        c = alloc.alloc(3)          # reuses the holes
+        assert set(c) == set(a[1:4])
+        alloc.free(b)
+        alloc.free(c)
+        alloc.free([a[0], a[4]])
+        assert alloc.blocks_free == alloc.blocks_total == 16
+        assert set(alloc.alloc(16)) == set(range(1, 17))
+
+    def test_exhaustion_is_atomic(self):
+        alloc = BlockAllocator(5, 2)
+        alloc.alloc(3)
+        with pytest.raises(BlockExhausted):
+            alloc.alloc(2)
+        # the failed alloc took nothing
+        assert alloc.blocks_free == 1
+        assert alloc.alloc(1)
+
+    def test_double_free_and_bad_range_raise(self):
+        alloc = BlockAllocator(5, 2)
+        got = alloc.alloc(2)
+        alloc.free(got)
+        with pytest.raises(ValueError, match="double free"):
+            alloc.free([got[0]])
+        with pytest.raises(ValueError, match="range"):
+            alloc.free([0])
+
+    def test_blocks_for(self):
+        assert slot_cache.blocks_for(1, 8) == 1
+        assert slot_cache.blocks_for(8, 8) == 1
+        assert slot_cache.blocks_for(9, 8) == 2
+
+
+class TestGreedyParityAcrossBoundaries:
+    @pytest.mark.l0
+    @pytest.mark.parametrize("which", ["gpt", "llama"])
+    def test_engine_matches_generate(self, which, request):
+        """block_size=8, chunk=4: prompt lengths straddle the page
+        boundary (7/8/9), the chunk boundary (3/4/5), their common
+        multiples (15/16/17) and a multi-page prompt (23) — every
+        chain must reproduce generate() exactly, including requests
+        that queue behind the first wave."""
+        model, params = request.getfixturevalue(which)
+        rng = np.random.default_rng(3)
+        lengths = (7, 8, 9, 3, 4, 5, 15, 16, 17, 23)
+        budgets = [6, 3, 5, 7, 4, 8, 3, 5, 6, 4]
+        prompts = [rng.integers(0, model.cfg.vocab_size,
+                                size=(L,)).astype(np.int32)
+                   for L in lengths]
+        engine = PagedEngine(model, params, max_slots=3, block_size=8,
+                             prefill_chunk=4)
+        sched = Scheduler(engine)
+        reqs = [sched.submit(Request(prompt=p, max_new_tokens=n))
+                for p, n in zip(prompts, budgets)]
+        sched.drain()
+        for p, n, r in zip(prompts, budgets, reqs):
+            ref = np.asarray(generate(
+                model, params, jnp.asarray(p[None]),
+                max_new_tokens=n))[0, len(p):]
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens), ref,
+                err_msg=f"{which} prompt_len={len(p)} n={n}")
+        assert engine.blocks_in_use == 0
+
+    def test_tenant_near_max_seq_len_survives_cotenant_prefill(self):
+        """Regression (review finding): a tenant decoding within one
+        chunk of max_seq_len rides a WIDE mixed step when a co-tenant
+        chunk-prefills; its pad positions past max_seq_len must land
+        in the null page, NOT wrap into its last live block (the old
+        clamp overwrote visible K/V and flipped late greedy tokens)."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            GPTConfig.tiny(position_embedding="learned",
+                           scan_layers=True), max_seq_len=16)
+        model = GPTModel(cfg)
+        params = {"params": model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 4), jnp.int32))["params"]}
+        rng = np.random.default_rng(5)
+        pa = rng.integers(0, cfg.vocab_size, size=(2,)).astype(np.int32)
+        ref_a = np.asarray(generate(
+            model, params, jnp.asarray(pa[None]),
+            max_new_tokens=14))[0, 2:]          # fills the cache: 2+14=16
+        engine = PagedEngine(model, params, max_slots=2, block_size=8,
+                             prefill_chunk=4)
+        sched = Scheduler(engine)
+        ra = sched.submit(Request(prompt=pa, max_new_tokens=14))
+        for _ in range(10):                     # decode A near the end
+            sched.run_step()
+        pb = rng.integers(0, cfg.vocab_size,
+                          size=(10,)).astype(np.int32)
+        rb = sched.submit(Request(prompt=pb, max_new_tokens=2))
+        sched.drain()
+        np.testing.assert_array_equal(np.asarray(ra.tokens), ref_a)
+        ref_b = np.asarray(generate(
+            model, params, jnp.asarray(pb[None]),
+            max_new_tokens=2))[0, 10:]
+        np.testing.assert_array_equal(np.asarray(rb.tokens), ref_b)
+
+    def test_eos_stops_early_and_matches_generate(self, gpt):
+        model, params = gpt
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, model.cfg.vocab_size,
+                              size=(9,)).astype(np.int32)
+        n = 8
+        ref = np.asarray(generate(
+            model, params, jnp.asarray(prompt[None]),
+            max_new_tokens=n))[0, 9:]
+        eos = int(ref[2])
+        engine = PagedEngine(model, params, max_slots=1, block_size=8,
+                             prefill_chunk=4)
+        sched = Scheduler(engine)
+        req = sched.submit(Request(prompt=prompt, max_new_tokens=n,
+                                   eos_id=eos))
+        sched.drain()
+        got = np.asarray(req.tokens)
+        first = int(np.argmax(ref == eos))
+        np.testing.assert_array_equal(got, ref[:first + 1])
+        assert got[-1] == eos and len(got) < n
+
+
+class TestSoakZeroRetraces:
+    def test_mixed_chunked_prefill_decode_soak(self, gpt):
+        """The acceptance soak: chunked-prefill admissions interleave
+        with steady decode across 14 requests / 3 slots, mixed
+        temperature / top_k / top_p / eos / budgets — zero jaxpr
+        traces after warmup, and the guards pin the budget to the
+        documented constants: decode_step = prefill_step = admit =
+        release = 1."""
+        model, params = gpt
+        engine = PagedEngine(model, params, max_slots=3, block_size=8,
+                             prefill_chunk=4)
+        sched = Scheduler(engine)
+        engine.warmup()
+        assert engine.trace_counts == {
+            "decode_step": 1, "prefill_step": 1, "admit": 1,
+            "release": 1}
+
+        rng = np.random.default_rng(11)
+        before = tracecheck.trace_event_count()
+        cases = [
+            (3, 4, 0.0, None, None, None),
+            (7, 3, 0.8, 20, None, None),
+            (12, 5, 1.2, 5, None, 0.9), (2, 6, 0.0, None, 17, None),
+            (8, 2, 0.5, None, None, 0.5),
+            (16, 4, 0.0, None, None, None),
+            (5, 3, 1.0, 50, 3, 0.95), (4, 5, 0.0, None, None, None),
+            (9, 4, 0.7, 10, None, None), (1, 2, 0.0, None, None, None),
+            (13, 3, 1.5, 2, None, 1.0), (6, 6, 0.0, None, 900, None),
+            (11, 2, 0.9, None, None, 0.7),
+            (8, 4, 0.0, None, None, None),
+        ]
+        reqs = []
+        for i, (L, n, t, k, eos, p) in enumerate(cases):
+            reqs.append(sched.submit(Request(
+                prompt=rng.integers(0, model.cfg.vocab_size,
+                                    size=(L,)).astype(np.int32),
+                max_new_tokens=n, temperature=t, top_k=k, top_p=p,
+                eos_id=eos, seed=i)))
+        events = sched.drain()
+        assert tracecheck.trace_event_count() == before, (
+            "steady-state paged soak retraced after warmup")
+        assert engine.trace_counts == {
+            "decode_step": 1, "prefill_step": 1, "admit": 1,
+            "release": 1}
+        for (L, n, t, k, eos, p), r in zip(cases, reqs):
+            assert 1 <= len(r.tokens) <= n
+            if eos is None:
+                assert len(r.tokens) == n
+        assert len(events) == sum(len(r.tokens) for r in reqs)
+        assert engine.blocks_in_use == 0
+
+
+class TestTokenBudgetAdmission:
+    def test_can_admit_gates_on_free_pages(self, gpt):
+        model, params = gpt
+        engine = PagedEngine(model, params, max_slots=4, block_size=8,
+                             pool_tokens=64, prefill_chunk=4,
+                             admit_headroom=8)
+        # empty pool: plenty of room
+        assert engine.can_admit(16, 8)
+        # occupy almost everything via a long tenant
+        engine.admit(0, np.zeros(40, np.int32), max_new_tokens=8)
+        while engine._tenants[0] is not None \
+                and engine._tenants[0].fed < 40:
+            engine.step()
+        assert engine.blocks_in_use >= 5
+        # 3 free pages (24 tokens) left: 18+8 tokens of prompt +
+        # headroom need a 4th page — blocked; 16+8 exactly fits
+        assert not engine.can_admit(18, 8)
+        assert engine.can_admit(16, 8)
+        engine.release(0)
+        assert engine.blocks_in_use == 0
+
+    def test_request_bigger_than_pool_rejected_at_submit(self, gpt):
+        model, params = gpt
+        engine = PagedEngine(model, params, max_slots=1, block_size=8,
+                             pool_tokens=32, prefill_chunk=4)
+        sched = Scheduler(engine)
+        with pytest.raises(ValueError, match="pool"):
+            sched.submit(Request(prompt=np.zeros(30, np.int32),
+                                 max_new_tokens=10))
+        # and the usual envelope checks still apply
+        with pytest.raises(ValueError, match="max_seq_len"):
+            engine.validate_request(8, model.cfg.max_seq_len)
+        with pytest.raises(ValueError, match="top_k"):
+            engine.validate_request(4, 2,
+                                    top_k=model.cfg.vocab_size + 1)
+
+    def test_occupied_slot_rejected(self, gpt):
+        model, params = gpt
+        engine = PagedEngine(model, params, max_slots=1, block_size=8,
+                             prefill_chunk=4)
+        engine.admit(0, np.zeros(4, np.int32), max_new_tokens=2)
+        with pytest.raises(ValueError, match="occupied"):
+            engine.admit(0, np.zeros(4, np.int32), max_new_tokens=2)
+
+
+class TestPreemption:
+    def test_exhaustion_preempts_requeues_and_stays_token_identical(
+            self, gpt):
+        """Two tenants overcommit a pool that cannot hold both live
+        sequences: the youngest is preempted (pages freed), requeued,
+        and continues from its streamed prefix — both greedy chains
+        still match generate() token for token, and the pool drains
+        to zero."""
+        model, params = gpt
+        engine = PagedEngine(model, params, max_slots=2, block_size=8,
+                             pool_tokens=64, prefill_chunk=4,
+                             admit_headroom=0)
+        sched = Scheduler(engine)
+        engine.warmup()
+        rng = np.random.default_rng(7)
+        p1 = rng.integers(0, model.cfg.vocab_size,
+                          size=(20,)).astype(np.int32)
+        p2 = rng.integers(0, model.cfg.vocab_size,
+                          size=(22,)).astype(np.int32)
+        r1 = sched.submit(Request(prompt=p1, max_new_tokens=30))
+        r2 = sched.submit(Request(prompt=p2, max_new_tokens=28))
+        sched.drain()
+        assert sched.preempts >= 1
+        for p, n, r in ((p1, 30, r1), (p2, 28, r2)):
+            ref = np.asarray(generate(
+                model, params, jnp.asarray(p[None]),
+                max_new_tokens=n))[0, len(p):]
+            np.testing.assert_array_equal(np.asarray(r.tokens), ref)
+        assert engine.blocks_in_use == 0
+        # recovery replays compiled programs — budgets untouched
+        assert engine.trace_counts == {
+            "decode_step": 1, "prefill_step": 1, "admit": 1,
+            "release": 1}
+
+    def test_eviction_releases_blocks(self, gpt):
+        """scheduler.evict (the deadline/fault path) returns every
+        page to the pool."""
+        model, params = gpt
+        engine = PagedEngine(model, params, max_slots=2, block_size=8,
+                             prefill_chunk=4)
+        sched = Scheduler(engine)
+        sched.submit(Request(prompt=np.zeros(12, np.int32),
+                             max_new_tokens=50))
+        for _ in range(6):
+            sched.run_step()
+        assert engine.blocks_in_use >= 2
+        assert sched.active_count == 1
+        sched.evict(0)
+        assert engine.blocks_in_use == 0
+        assert sched.active_count == 0
+
+
+class TestSamplingDeterminism:
+    def test_tokens_independent_of_cotenants(self, gpt):
+        """A sampled request's chain is a function of its own seed —
+        co-tenant traffic (and the chunked prefill it causes) must not
+        perturb it: the k-th produced token always consumes the k-th
+        rng split (emission-gated rng advance)."""
+        model, params = gpt
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, model.cfg.vocab_size,
+                              size=(6,)).astype(np.int32)
+
+        def run(extra_traffic):
+            engine = PagedEngine(model, params, max_slots=2,
+                                 block_size=8, prefill_chunk=4)
+            sched = Scheduler(engine)
+            req = sched.submit(Request(
+                prompt=prompt, max_new_tokens=5, temperature=0.9,
+                top_k=20, seed=123))
+            if extra_traffic:
+                for i in range(3):
+                    sched.submit(Request(
+                        prompt=rng.integers(
+                            0, model.cfg.vocab_size,
+                            size=(4 + i,)).astype(np.int32),
+                        max_new_tokens=4, temperature=1.3, seed=i))
+            sched.drain()
+            return list(req.tokens)
+
+        assert run(False) == run(True)
+
+
+class TestPagedServer:
+    def test_streaming_parity_metrics_and_gauges(self, gpt):
+        model, params = gpt
+        rng = np.random.default_rng(13)
+        prompt = rng.integers(0, model.cfg.vocab_size,
+                              size=(9,)).astype(np.int32)
+        ref = np.asarray(generate(
+            model, params, jnp.asarray(prompt[None]),
+            max_new_tokens=5))[0, 9:]
+        rows = []
+        writer = MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+        server = InferenceServer(
+            model, params, max_slots=2, kv_cache="paged", block_size=8,
+            prefill_chunk=4, metrics=writer, metrics_interval=2)
+        with server:
+            h1 = server.submit(prompt, max_new_tokens=5)
+            h2 = server.submit(
+                rng.integers(0, model.cfg.vocab_size, size=(6,)),
+                max_new_tokens=3, temperature=0.8, seed=4)
+            got = h1.result(timeout=300)
+            assert len(h2.result(timeout=300)) == 3
+            health = server.health()
+        np.testing.assert_array_equal(np.asarray(got), ref)
+        # the occupancy gauge and latency percentiles ride health +
+        # every metrics emission
+        assert health["blocks_total"] == server.engine.blocks_total
+        assert health["blocks_in_use"] == 0
+        assert health["preempts"] == 0
+        assert rows, "metrics never emitted"
+        merged = {}
+        for _, m in rows:
+            merged.update(m)
+        assert {"tokens_per_sec", "occupancy", "queue_depth",
+                "blocks_in_use", "blocks_total", "ttft_p50_s",
+                "ttft_p99_s", "step_ms_p50",
+                "step_ms_p99"} <= set(merged)
+        assert merged["ttft_p50_s"] > 0
+        summary = server.latency_summary()
+        assert summary["ttft_p99_s"] >= summary["ttft_p50_s"]
+
+    def test_invalid_kv_cache_rejected(self, gpt):
+        model, params = gpt
+        with pytest.raises(ValueError, match="kv_cache"):
+            InferenceServer(model, params, kv_cache="sparse")
+
+
+class TestTrafficModel:
+    def test_serving_traffic_model_scales_with_live_tokens(self):
+        """The analytic per-step KV traffic model (bench_configs):
+        dense bytes pinned at max_seq_len, paged bytes ∝ live pages;
+        the paged pool footprint is sized in tokens."""
+        import bench_configs as bc
+
+        cfg = dict(num_layers=4, kv_heads=2, head_dim=64,
+                   max_seq_len=2048, dtype_bytes=2, slots=8,
+                   block_size=16)
+        small = bc._serving_traffic_model(live_tokens=128, **cfg)
+        big = bc._serving_traffic_model(live_tokens=512, **cfg)
+        for out in (small, big):
+            assert {"dense_kv_read_bytes_per_step",
+                    "paged_kv_read_bytes_per_step",
+                    "dense_pool_bytes", "paged_pool_tokens"} <= set(out)
+        # dense per-step reads are live-independent; paged scale ~4x
+        assert small["dense_kv_read_bytes_per_step"] \
+            == big["dense_kv_read_bytes_per_step"]
+        ratio = (big["paged_kv_read_bytes_per_step"]
+                 / small["paged_kv_read_bytes_per_step"])
+        assert 3.5 <= ratio <= 4.5
+        assert small["paged_kv_read_bytes_per_step"] \
+            < small["dense_kv_read_bytes_per_step"]

@@ -23,6 +23,10 @@ import time
 SUBSET = [
     "tests/test_attention.py",
     "tests/test_batch_norm.py",    # fused BN(+add+ReLU) kernels (ISSUE 3)
+    # paged-attention decode kernel (ISSUE 5): scalar-prefetch block
+    # tables + the DMA-skip clamp are exactly what interpret mode
+    # cannot prove — the gather path must run on the real chip
+    "tests/test_paged_attention.py",
     "tests/test_layer_norm.py",
     "tests/test_ops.py",
     "tests/test_optim.py",
