@@ -25,6 +25,21 @@ site                 where
                      step
 ``serving.admit``    ``Scheduler._admit_from_queue``, before each
                      engine admission
+``fleet.route``      ``FleetRouter._dispatch``, once per routing
+                     attempt (step = a fleet-wide attempt counter); a
+                     raising kind fails the attempt, which backs off
+                     and retries onto the next-best replica
+``fleet.probe``      ``FleetRouter`` supervisor, once per replica
+                     health probe (step = the supervisor tick, shared
+                     by every replica probed that tick); a raising
+                     kind counts as a failed probe for that replica's
+                     circuit breaker
+``replica.kill``     ``FleetRouter`` supervisor, once per live replica
+                     per tick (step = the tick); ANY raising kind
+                     fired here SIGKILL-equivalently kills that
+                     replica (``InferenceServer.kill``: worker dies,
+                     engine state abandoned, tenants migrate to
+                     survivors)
 ``data.next``        ``PrefetchLoader``'s worker, around each pull
                      from the source iterator
 ==================== ==============================================

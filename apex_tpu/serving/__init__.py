@@ -13,19 +13,30 @@ path, in two cache layouts:
   ``max_slots × max_seq_len`` slotted slab with bucket-padded prefill.
 
 Plus a bounded FIFO queue with admission/eviction at step boundaries
-(:mod:`~apex_tpu.serving.scheduler`) and a threaded submit/stream
+(:mod:`~apex_tpu.serving.scheduler`), a threaded submit/stream
 front-end with TTFT / step-latency / pool-occupancy telemetry
-(:mod:`~apex_tpu.serving.api`).  Greedy decode through either engine
-is token-identical to ``apex_tpu.models.generate``; steady state is
-retrace-free and *enforced* so by ``tracecheck.retrace_guard``.  See
-docs/serving.md.
+(:mod:`~apex_tpu.serving.api`), and a multi-replica fleet front door
+(:mod:`~apex_tpu.serving.fleet`): least-loaded health-gated routing
+across N replica servers with circuit breakers, graceful drain,
+replica-kill tenant migration, and queue-depth/TTFT-driven scale
+hooks.  Greedy decode through either engine is token-identical to
+``apex_tpu.models.generate`` — including across a migration; steady
+state is retrace-free and *enforced* so by
+``tracecheck.retrace_guard``.  See docs/serving.md and docs/fleet.md.
 """
 
 from apex_tpu.serving.api import (
     InferenceServer,
+    ReplicaDraining,
     RequestFailed,
     RequestHandle,
     ServerClosed,
+)
+from apex_tpu.serving.fleet import (
+    AutoscaleConfig,
+    CircuitBreaker,
+    FleetHandle,
+    FleetRouter,
 )
 from apex_tpu.serving.engine import (
     DEFAULT_BUCKETS,
@@ -46,6 +57,11 @@ __all__ = [
     "RequestHandle",
     "RequestFailed",
     "ServerClosed",
+    "ReplicaDraining",
+    "FleetRouter",
+    "FleetHandle",
+    "CircuitBreaker",
+    "AutoscaleConfig",
     "Engine",
     "PagedEngine",
     "StepOutput",

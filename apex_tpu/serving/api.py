@@ -24,25 +24,44 @@ import queue as queue_mod
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from apex_tpu.resilience import faults
 from apex_tpu.serving.engine import DEFAULT_BUCKETS, Engine, PagedEngine
 from apex_tpu.serving.scheduler import QueueFull, Request, Scheduler
-from apex_tpu.utils.metrics import MetricsWriter, counters
+from apex_tpu.utils.metrics import (
+    MetricsWriter,
+    counters,
+    percentile_summary,
+)
 
 __all__ = ["InferenceServer", "RequestHandle", "ServerClosed",
-           "RequestFailed"]
+           "RequestFailed", "ReplicaDraining"]
 
 _SENTINEL = object()
+
+#: server-side observer a fleet router attaches to a handle:
+#: ``tap(token, finished, error)`` — token events carry ``(tok, fin,
+#: None)``, the terminal failure carries ``(None, True, exc)``.
+Tap = Callable[[Optional[int], bool, Optional[BaseException]], None]
 
 
 class ServerClosed(RuntimeError):
     """TERMINAL: the server shut down (or its worker died) before the
     request finished — the request will never produce more tokens.
     Also raised by ``submit`` on a stopped server."""
+
+
+class ReplicaDraining(ServerClosed):
+    """TERMINAL *for this replica only*: the server is gracefully
+    draining (:meth:`InferenceServer.begin_drain`) and evicted the
+    request — its engine slot is released, its streamed prefix is
+    intact — so a fleet router can migrate it (``prompt ++ streamed
+    tokens``, remaining budget) onto a survivor.  Plain clients
+    without a router on top should treat it exactly as
+    :class:`ServerClosed`."""
 
 
 class RequestFailed(RuntimeError):
@@ -70,11 +89,15 @@ class RequestHandle:
     state — never a timeout that silently means "cancelled".
     """
 
-    def __init__(self, request: Request):
+    def __init__(self, request: Request, tap: Optional[Tap] = None):
         self._request = request
         self._stream: "queue_mod.Queue" = queue_mod.Queue()
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
+        # server-side observer (fleet plumbing): installed at
+        # construction so no event can slip past it — a fast worker
+        # may deliver before submit() even returns
+        self._tap = tap
 
     # ------------------------------------------------------- server side
     def _deliver(self, token: int, finished: bool) -> None:
@@ -82,12 +105,16 @@ class RequestHandle:
         if finished:
             self._stream.put(_SENTINEL)
             self._done.set()
+        if self._tap is not None:
+            self._tap(int(token), bool(finished), None)
 
     def _fail(self, error: BaseException) -> None:
         """Terminal failure: record the cause, then wake clients."""
         self._error = error
         self._stream.put(_SENTINEL)
         self._done.set()
+        if self._tap is not None:
+            self._tap(None, True, error)
 
     def _cancel(self) -> None:
         self._fail(ServerClosed(
@@ -204,6 +231,9 @@ class InferenceServer:
         self._wakeup = threading.Condition()
         self._stop = False
         self._drain_on_stop = True
+        self._draining = False
+        self._drain_evicted = 0
+        self._started_at: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         self._steps = 0
         self._step_attempts = 0
@@ -229,6 +259,7 @@ class InferenceServer:
             raise RuntimeError("server already started")
         if warmup:
             self.engine.warmup()
+        self._started_at = time.monotonic()
         self._thread = threading.Thread(
             target=self._serve, name="apex-tpu-serving", daemon=True)
         self._thread.start()
@@ -245,6 +276,51 @@ class InferenceServer:
         self._thread.join(timeout)
         self._thread = None
 
+    def begin_drain(self) -> None:
+        """Graceful drain, phase 1: stop admitting and evict every
+        queued/in-flight request at the next step boundary, failing
+        each handle with :class:`ReplicaDraining` so a fleet router
+        can migrate it (``prompt ++ streamed tokens`` onto a
+        survivor).  The engine releases every slot through the normal
+        compiled ``release`` — a paged pool returns to
+        ``blocks_in_use == 0`` — and the worker then idles until
+        :meth:`shutdown`.  Without a router on top, clients simply
+        observe :class:`ServerClosed` (its base class)."""
+        with self._wakeup:
+            self._draining = True
+            self._wakeup.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        """True after :meth:`begin_drain` — also in :meth:`health`."""
+        return self._draining
+
+    def kill(self, error: Optional[BaseException] = None) -> None:
+        """SIGKILL-equivalent death for chaos drills (the
+        ``replica.kill`` fault site routes here): the worker stops
+        WITHOUT draining and WITHOUT releasing engine state — a real
+        SIGKILL takes the host's device memory with it — so a paged
+        pool's accounting is abandoned mid-flight (``blocks_in_use``
+        stays nonzero; the replica is dead, not reusable).  Every
+        queued and in-flight handle fails with :class:`ServerClosed`;
+        a :class:`~apex_tpu.serving.fleet.FleetRouter` migrates them
+        onto survivors.  Idempotent; a no-op on a server with no live
+        worker (never started, or already shut down cleanly) — there
+        is nothing to kill, and fabricating an ``error`` there would
+        make ``health()`` report a failure that never happened."""
+        with self._wakeup:
+            thread = self._thread
+            if thread is None:
+                return
+            if self.error is None:
+                self.error = error if error is not None \
+                    else RuntimeError("replica killed (chaos drill)")
+            self._stop = True
+            self._drain_on_stop = False
+            self._wakeup.notify_all()
+        thread.join()
+        self._thread = None
+
     def __enter__(self) -> "InferenceServer":
         return self.start()
 
@@ -259,14 +335,19 @@ class InferenceServer:
                eos_id: Optional[int] = None, seed: int = 0,
                deadline: Optional[float] = None,
                block: bool = True,
-               timeout: Optional[float] = None) -> RequestHandle:
+               timeout: Optional[float] = None,
+               tap: Optional[Tap] = None) -> RequestHandle:
         """Enqueue one request; returns its :class:`RequestHandle`.
 
         ``deadline`` (seconds from acceptance) bounds the request's
         total latency: once expired — whether still queued or
         mid-decode — it fails with :class:`RequestFailed` and its slot
         is freed.  ``timeout`` bounds only this *submission* under
-        backpressure (distinct from the deadline).
+        backpressure (distinct from the deadline).  ``tap`` is fleet
+        plumbing: a server-side observer of the handle's events (see
+        :data:`Tap`), installed before the request can produce any —
+        :class:`~apex_tpu.serving.fleet.FleetRouter` uses it to mirror
+        streams and catch migration signals.
         """
         request = Request(
             prompt=np.asarray(prompt, np.int32).reshape(-1),
@@ -280,7 +361,7 @@ class InferenceServer:
         # the enqueue and any later registration, and its events would
         # be dropped.  Keyed by object identity (stable pre-enqueue;
         # uid is only assigned inside scheduler.submit).
-        handle = RequestHandle(request)
+        handle = RequestHandle(request, tap=tap)
         self._handles[id(request)] = handle
         # distinct from the per-request `deadline`: this bounds only
         # the backpressure wait of THIS submit call
@@ -291,6 +372,9 @@ class InferenceServer:
                 with self._wakeup:
                     if self._stop or self._thread is None:
                         raise ServerClosed("server is not running")
+                    if self._draining:
+                        raise ServerClosed(
+                            "server is draining (not admitting)")
                     try:
                         self.scheduler.submit(request)
                         self._wakeup.notify_all()
@@ -321,6 +405,9 @@ class InferenceServer:
                     if self._stop and (not self._drain_on_stop
                                        or not self.scheduler.has_work()):
                         break
+                if self._draining:
+                    self._drain_out()
+                    continue            # idle until shutdown()
                 self._expire_deadlines()
                 if not self.scheduler.has_work():
                     continue                # everything just expired
@@ -402,6 +489,27 @@ class InferenceServer:
                     and self._steps != self._last_emit_step:
                 self._emit_metrics(time.monotonic())
 
+    def _drain_out(self) -> None:
+        """Evict everything for :meth:`begin_drain` (worker thread):
+        queued requests are cancelled, active tenants evicted with
+        their engine slots released (pages go home), and every handle
+        fails with :class:`ReplicaDraining` — the router-visible
+        migrate signal.  Not counted as request failures: drain is
+        scheduling, not loss."""
+        dropped = self.scheduler.cancel_queued()
+        dropped += self.scheduler.evict_all()
+        for req in dropped:
+            self._drain_evicted += 1
+            counters.inc("serving.drain_evict")
+            handle = self._handles.pop(id(req), None)
+            if handle is not None:
+                handle._fail(ReplicaDraining(
+                    f"request {req.uid} evicted by graceful drain "
+                    f"after {len(req.tokens)} streamed tokens"))
+        if dropped:
+            with self._wakeup:
+                self._wakeup.notify_all()
+
     # ----------------------------------------------------- fault recovery
     def _fail_request(self, req: Request,
                       failure: RequestFailed) -> None:
@@ -470,19 +578,14 @@ class InferenceServer:
         over the bounded reservoirs (seconds / milliseconds) — the
         soak-summary numbers; also folded into every metrics
         emission."""
-        out: Dict[str, float] = {}
         # snapshot first: the worker thread appends concurrently, and
         # iterating a deque during an append raises RuntimeError
-        ttft_snap = list(self._ttft)
-        step_snap = list(self._step_times)
-        if ttft_snap:
-            ttft = np.asarray(ttft_snap, np.float64)
-            out["ttft_p50_s"] = float(np.percentile(ttft, 50))
-            out["ttft_p99_s"] = float(np.percentile(ttft, 99))
-        if step_snap:
-            st = np.asarray(step_snap, np.float64) * 1e3
-            out["step_ms_p50"] = float(np.percentile(st, 50))
-            out["step_ms_p99"] = float(np.percentile(st, 99))
+        out: Dict[str, float] = {}
+        out.update(percentile_summary(
+            list(self._ttft), "ttft_p50_s", "ttft_p99_s"))
+        out.update(percentile_summary(
+            list(self._step_times), "step_ms_p50", "step_ms_p99",
+            scale=1e3))
         return out
 
     def _emit_metrics(self, now: float) -> None:
@@ -503,6 +606,7 @@ class InferenceServer:
             # pool occupancy gauge (paged engine): the overcommit dial
             payload["blocks_in_use"] = self.engine.blocks_in_use
             payload["blocks_total"] = blocks_total
+            payload["live_tokens"] = self.engine.live_tokens
         self.metrics(self._steps, payload)
         self.metrics.drain()
         self._last_emit_step = self._steps
@@ -514,16 +618,22 @@ class InferenceServer:
         """Readiness/liveness probe (cheap; any thread).
 
         ``status`` is ``"serving"`` (worker alive, accepting),
-        ``"stopped"`` (never started, shut down, or draining out), or
+        ``"stopped"`` (never started, shut down, or stopping), or
         ``"failed"`` (worker died — root cause in ``error``);
-        ``ready`` is the single boolean a load balancer should gate
-        on.  Counter fields make the probe double as the chaos-soak
-        scoreboard: accepted == completed + failed when nothing is
-        lost.
+        ``ready`` is the single boolean a load balancer should gate on
+        — a *draining* replica stays ``status="serving"`` but reports
+        ``ready=False`` (and ``draining=True``) so routers stop
+        admitting without treating it as a failure.  ``uptime_s`` is
+        seconds since :meth:`start`.  Counter fields make the probe
+        double as the chaos-soak scoreboard: accepted == completed +
+        failed when nothing is lost.  The full field table lives in
+        ``docs/serving.md``.
         """
+        now = time.monotonic()
         with self._wakeup:
             alive = self._thread is not None and self._thread.is_alive()
             stopping = self._stop
+            draining = self._draining
         if self.error is not None:
             status = "failed"
         elif not alive or stopping:
@@ -532,7 +642,10 @@ class InferenceServer:
             status = "serving"
         out = {
             "status": status,
-            "ready": status == "serving",
+            "ready": status == "serving" and not draining,
+            "draining": draining,
+            "uptime_s": (0.0 if self._started_at is None
+                         else now - self._started_at),
             "steps": self._steps,
             "queue_depth": self.scheduler.queue_depth,
             "occupancy": self.scheduler.occupancy,
@@ -540,6 +653,7 @@ class InferenceServer:
             "requeues": self._requeues,
             "failed_requests": self._failed_requests,
             "deadline_expired": self._deadline_expired,
+            "drain_evicted": self._drain_evicted,
             "preempts": self.scheduler.preempts,
             "error": None if self.error is None else repr(self.error),
         }
@@ -547,6 +661,7 @@ class InferenceServer:
         if blocks_total:
             out["blocks_in_use"] = self.engine.blocks_in_use
             out["blocks_total"] = blocks_total
+            out["live_tokens"] = self.engine.live_tokens
         return out
 
     # ---------------------------------------------------------- telemetry

@@ -797,6 +797,15 @@ class PagedEngine:
         return self._alloc.tokens_total
 
     @property
+    def live_tokens(self) -> int:
+        """Tokens currently written for live tenants (host-side view)
+        — a finer utilization numerator than whole pages; surfaced in
+        ``InferenceServer.health()``/metrics so a fleet router can see
+        real load, not just page-granular occupancy."""
+        return int(sum(t.cursor for t in self._tenants
+                       if t is not None))
+
+    @property
     def trace_counts(self) -> dict:
         """Observed traces per executable (diagnostics / tests)."""
         return {

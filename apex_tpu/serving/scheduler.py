@@ -258,6 +258,20 @@ class Scheduler:
         self._slots[slot] = None
         return req
 
+    def evict_all(self) -> List[Request]:
+        """Evict every active tenant and return them in slot order —
+        the graceful-drain path (``InferenceServer.begin_drain``).
+        Engine rows are released through the same compiled ``release``
+        as normal completion, so a paged pool gets all its pages back
+        (``blocks_in_use`` returns to 0 once the queue is also
+        cancelled).  Call from the engine-owning thread only."""
+        evicted: List[Request] = []
+        for slot in range(len(self._slots)):
+            req = self.evict(slot)
+            if req is not None:
+                evicted.append(req)
+        return evicted
+
     def run_step(self) -> List[StepEvent]:
         """One step boundary: admit → decode → route/evict.
 

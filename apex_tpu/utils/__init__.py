@@ -17,7 +17,9 @@ from apex_tpu.utils import profiler
 from apex_tpu.utils.debug import (
     enable_nan_checks, nan_check_mode, checkify_finite, tree_health,
 )
-from apex_tpu.utils.metrics import MetricsWriter, log_metrics
+from apex_tpu.utils.metrics import (
+    MetricsWriter, log_metrics, namespaced_sink,
+)
 from apex_tpu.utils.tracecheck import (
     RetraceError, retrace_guard, trace_event_count,
     reset_trace_event_count,
@@ -37,7 +39,7 @@ __all__ = [
     "profiler",
     "enable_nan_checks", "nan_check_mode", "checkify_finite",
     "tree_health",
-    "MetricsWriter", "log_metrics",
+    "MetricsWriter", "log_metrics", "namespaced_sink",
     "RetraceError", "retrace_guard", "trace_event_count",
     "reset_trace_event_count",
 ]

@@ -20,11 +20,12 @@ from __future__ import annotations
 import bisect
 import logging
 import threading
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 import jax
 
-__all__ = ["MetricsWriter", "log_metrics", "Counters", "counters"]
+__all__ = ["MetricsWriter", "log_metrics", "namespaced_sink",
+           "percentile_summary", "Counters", "counters"]
 
 _logger = logging.getLogger("apex_tpu.metrics")
 
@@ -102,11 +103,16 @@ class MetricsWriter:
         # drains interleave their history/sink phases out of order);
         # separate from _lock so a slow sink never blocks emitters
         self._drain_lock = threading.Lock()
+        # one past the largest step ever staged — the fresh-step axis
+        # merge()/advance_step() allocate from when aggregating writers
+        # whose own step counters collide
+        self._axis = 0
 
     def __call__(self, step: int, metrics: Dict[str, Any]) -> None:
         step = int(step)
         row = {k: float(v) for k, v in metrics.items()}
         with self._lock:
+            self._axis = max(self._axis, step + 1)
             if step in self._seen:
                 return                      # step already drained
             staged = self._pending.get(step)
@@ -143,6 +149,94 @@ class MetricsWriter:
     def pending_count(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    # ------------------------------------------------- fleet aggregation
+    def advance_step(self) -> int:
+        """Allocate the next unused step on this writer's axis (one
+        past everything staged or drained so far).
+
+        Use it when this writer aggregates OTHER writers whose step
+        counters are unrelated (N serving replicas each count their
+        own steps): tag aggregate rows with ``advance_step()`` and
+        they interleave in arrival order instead of colliding with —
+        and being deduped against — an unrelated source's step tag.
+        :meth:`merge` and :func:`namespaced_sink` allocate from the
+        same axis, so merged and direct emissions stay ordered.
+        """
+        with self._lock:
+            nxt = self._axis
+            self._axis += 1
+            return nxt
+
+    def merge(self, sources: Mapping[str, "MetricsWriter"]
+              ) -> List[Tuple[int, Dict[str, float]]]:
+        """Drain every source writer and restage its rows here — the
+        pull path for aggregating N per-replica writers into one fleet
+        view.
+
+        Each source is drained (its own step dedupe guarantees a row
+        is merged at most once, even across repeated ``merge`` calls)
+        and its rows are restaged on THIS writer's fresh-step axis
+        (:meth:`advance_step`), with every key namespaced
+        ``"<name>/<key>"`` and the source's own step preserved as
+        ``"<name>/step"`` — so replicas with colliding step counters
+        aggregate without clobbering each other: the per-step
+        first-wins merge never sees two sources share a staged step.
+        Per source, relative order is preserved (sources drain step-
+        ascending); sources are visited in sorted-name order.  Rows a
+        source already drained to its *own* sink are gone and cannot
+        be merged — hand the aggregator an undrained writer, or use
+        :func:`namespaced_sink` as that writer's sink (the push twin).
+        Returns the restaged rows; call :meth:`drain` to release the
+        combined view.
+        """
+        out: List[Tuple[int, Dict[str, float]]] = []
+        for name in sorted(sources):
+            for step, row in sources[name].drain():
+                merged = {f"{name}/{k}": v for k, v in row.items()}
+                merged[f"{name}/step"] = float(step)
+                tag = self.advance_step()
+                self(tag, merged)
+                out.append((tag, merged))
+        return out
+
+
+def namespaced_sink(name: str, target: MetricsWriter
+                    ) -> Callable[[int, Dict[str, float]], None]:
+    """A drain sink that forwards every row into ``target`` under the
+    ``name/`` key namespace — the push twin of
+    :meth:`MetricsWriter.merge` for writers that drain *themselves*.
+
+    Each replica :class:`~apex_tpu.serving.api.InferenceServer` drains
+    its own writer on its metrics interval; a fleet router hands each
+    replica ``MetricsWriter(sink=namespaced_sink(f"replica{i}",
+    fleet_writer))`` so all emissions land in one fleet writer, keys
+    namespaced and rows tagged on the fleet writer's fresh-step axis
+    (arrival order) — no step-tag collisions between replicas, the
+    source's own step preserved as ``"<name>/step"``.
+    """
+    def sink(step: int, row: Dict[str, float]) -> None:
+        merged = {f"{name}/{k}": v for k, v in row.items()}
+        merged[f"{name}/step"] = float(step)
+        target(target.advance_step(), merged)
+    return sink
+
+
+def percentile_summary(values, p50_key: str, p99_key: str, *,
+                       scale: float = 1.0) -> Dict[str, float]:
+    """p50/p99 of a reservoir snapshot as ``{p50_key: ..., p99_key:
+    ...}`` (empty dict when there are no samples) — the one
+    implementation behind the server and fleet latency summaries.
+    ``values`` should already be a snapshot (a list, not a live deque
+    another thread appends to); ``scale`` converts units (e.g. 1e3
+    for seconds → milliseconds)."""
+    import numpy as np
+
+    if not values:
+        return {}
+    arr = np.asarray(values, np.float64) * scale
+    return {p50_key: float(np.percentile(arr, 50)),
+            p99_key: float(np.percentile(arr, 99))}
 
 
 def log_metrics(writer: MetricsWriter, step, metrics: Dict[str, Any]) -> None:

@@ -67,7 +67,8 @@ PAGES = {
                "apex_tpu.models.generate",
                "apex_tpu.models.torch_import"],
     "serving": ["apex_tpu.serving.api", "apex_tpu.serving.engine",
-                "apex_tpu.serving.scheduler", "apex_tpu.serving.cache"],
+                "apex_tpu.serving.scheduler", "apex_tpu.serving.cache",
+                "apex_tpu.serving.fleet"],
     "resilience": ["apex_tpu.resilience.faults",
                    "apex_tpu.resilience.checkpointing",
                    "apex_tpu.resilience.trainer"],
@@ -106,6 +107,14 @@ def _render_symbol(name, obj, errors, qual):
     sig = _signature(obj) if kind != "data" else ""
     lines.append(f"### `{name}{sig}`\n")
     doc = inspect.getdoc(obj)
+    if kind == "data" and type(obj).__module__ == "builtins" \
+            and doc == inspect.getdoc(type(obj)):
+        # a bare BUILTIN constant (str/int/tuple instance) "inherits"
+        # its type's docstring through getdoc — boilerplate ("Create a
+        # new string object..."), not documentation.  Project-class
+        # singletons (e.g. metrics.counters) keep their class
+        # docstring: for those the fallback IS the documentation.
+        doc = None
     if not doc:
         if kind == "data":
             doc = f"*(module-level data: `{type(obj).__name__}`)*"

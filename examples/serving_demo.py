@@ -10,8 +10,15 @@ runs through ONE compiled decode step (per-slot sampling params are
 device arrays, prompts are bucketed) — the engine's retrace guards
 would raise if anything recompiled mid-traffic.
 
+With ``--replicas N`` (N > 1) the same traffic goes through a
+:class:`apex_tpu.serving.FleetRouter` front door instead: N paged
+replica servers, least-loaded health-gated routing by the
+blocks-occupancy gauge, and per-replica metrics aggregated into one
+fleet view (docs/fleet.md).
+
 Run (CPU works):
     python examples/serving_demo.py [--max-slots 2] [--requests 5]
+    python examples/serving_demo.py --replicas 3 --requests 8
 """
 
 from __future__ import annotations
@@ -25,6 +32,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-slots", type=int, default=2)
     ap.add_argument("--requests", type=int, default=5)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="N > 1 serves through a FleetRouter over N "
+                         "paged replica servers")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -32,7 +42,7 @@ def main():
     import jax.numpy as jnp
 
     from apex_tpu.models import GPTConfig, GPTModel
-    from apex_tpu.serving import InferenceServer
+    from apex_tpu.serving import FleetRouter, InferenceServer
     from apex_tpu.utils import MetricsWriter
 
     cfg = GPTConfig.tiny(position_embedding="learned",
@@ -60,16 +70,12 @@ def main():
     ]
     configs = [configs[i % len(configs)] for i in range(args.requests)]
 
-    server = InferenceServer(
-        model, params, max_slots=args.max_slots,
-        prompt_buckets=(4, 8, 16), metrics=metrics,
-        metrics_interval=4)
-    with server:
+    def submit_and_stream(front):
         handles = []
         for i, c in enumerate(configs):
             prompt = rng.integers(0, cfg.vocab_size,
                                   size=(c["length"],))
-            h = server.submit(
+            h = front.submit(
                 prompt,
                 max_new_tokens=c["max_new_tokens"],
                 temperature=c["temperature"],
@@ -79,6 +85,37 @@ def main():
         for i, prompt, h in handles:
             toks = list(h.stream(timeout=600))
             print(f"req {i} prompt={prompt.tolist()} -> {toks}")
+        return handles
+
+    if args.replicas > 1:
+        def factory():
+            return InferenceServer(
+                model, params, max_slots=args.max_slots,
+                kv_cache="paged", block_size=8, prefill_chunk=4,
+                pool_tokens=args.max_slots * cfg.max_seq_len,
+                metrics_interval=4)
+
+        router = FleetRouter(factory, replicas=args.replicas,
+                             probe_interval=0.1, metrics=metrics,
+                             metrics_interval=1)
+        with router:
+            handles = submit_and_stream(router)
+            stats = router.stats()
+            health = router.health()
+            print(f"fleet: replicas={args.replicas} "
+                  f"ready={health['replicas_ready']} "
+                  f"migrated={stats['migrated']}")
+        print(f"done: {len(handles)} requests, "
+              f"{stats['tokens_total']} tokens across "
+              f"{args.replicas} replicas")
+        return
+
+    server = InferenceServer(
+        model, params, max_slots=args.max_slots,
+        prompt_buckets=(4, 8, 16), metrics=metrics,
+        metrics_interval=4)
+    with server:
+        handles = submit_and_stream(server)
     print(f"done: {len(handles)} requests, "
           f"{server.tokens_emitted} tokens in {server.steps} steps")
 

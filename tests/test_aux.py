@@ -204,11 +204,83 @@ class TestMetrics:
         w.drain()
         assert [s for s, _ in w.history] == [2, 5, 9]
 
+    def test_merge_namespaces_colliding_steps(self):
+        """Two replicas with identical step counters aggregate into
+        one fleet view: keys are namespaced per source, the source's
+        own step rides along as ``<name>/step``, and the per-step
+        first-wins dedupe never clobbers across sources."""
+        a, b = utils.MetricsWriter(), utils.MetricsWriter()
+        a(0, {"tps": 1.0})
+        a(32, {"tps": 2.0})
+        b(0, {"tps": 10.0})          # same step tags as a — on purpose
+        b(32, {"tps": 20.0})
+        rows = []
+        fleet = utils.MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+        staged = fleet.merge({"r0": a, "r1": b})
+        assert len(staged) == 4
+        fleet.drain()
+        assert len(rows) == 4        # nothing deduped away
+        # per source: ascending source step, order preserved
+        r0 = [m for _, m in rows if "r0/tps" in m]
+        assert [m["r0/step"] for m in r0] == [0.0, 32.0]
+        assert [m["r0/tps"] for m in r0] == [1.0, 2.0]
+        r1 = [m for _, m in rows if "r1/tps" in m]
+        assert [m["r1/tps"] for m in r1] == [10.0, 20.0]
+        # the fleet axis itself is strictly ascending (drain order)
+        steps = [s for s, _ in rows]
+        assert steps == sorted(steps) and len(set(steps)) == 4
+
+    def test_merge_dedupes_across_repeated_merges(self):
+        a = utils.MetricsWriter()
+        a(1, {"x": 1.0})
+        fleet = utils.MetricsWriter(sink=lambda s, m: None)
+        assert len(fleet.merge({"a": a})) == 1
+        # a's row already drained into the fleet — a second merge (and
+        # a replayed emission of the same source step) stage nothing
+        assert fleet.merge({"a": a}) == []
+        a(1, {"x": 99.0})
+        assert fleet.merge({"a": a}) == []
+        # but a NEW source step flows through
+        a(2, {"x": 2.0})
+        assert len(fleet.merge({"a": a})) == 1
+
+    def test_merge_interleaves_with_direct_rows_via_advance_step(self):
+        """Aggregate summary rows tagged with advance_step() land
+        after the rows already merged — arrival order, no collisions
+        with any source's step axis."""
+        src = utils.MetricsWriter()
+        src(7, {"v": 1.0})
+        rows = []
+        fleet = utils.MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+        fleet(0, {"fleet/tick": 0.0})          # a direct early row
+        fleet.merge({"r": src})
+        fleet(fleet.advance_step(), {"fleet/tick": 1.0})
+        fleet.drain()
+        assert [sorted(m) for _, m in rows] == [
+            ["fleet/tick"], ["r/step", "r/v"], ["fleet/tick"]]
+        steps = [s for s, _ in rows]
+        assert steps == sorted(steps) and len(set(steps)) == 3
+
+    def test_namespaced_sink_pushes_into_target(self):
+        """The push twin: a writer that drains itself (the replica
+        server pattern) forwards its rows into the fleet writer."""
+        rows = []
+        fleet = utils.MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+        child = utils.MetricsWriter(
+            sink=utils.namespaced_sink("replica3", fleet))
+        child(5, {"tps": 2.5})
+        child.drain()                # the server-side self-drain
+        fleet.drain()
+        assert rows == [(0, {"replica3/tps": 2.5, "replica3/step": 5.0})]
+
 
 class TestProfiler:
     """jax.profiler wrappers (SURVEY.md §5 tracing row — exceeds the
     reference, which has no first-class profiling)."""
 
+    # [slow: ~14s of trace-collection I/O for a capability proof — the
+    # tier-1 wall budget rides its edge; runs under -m slow + on-chip]
+    @pytest.mark.slow
     def test_trace_writes_artifacts(self, tmp_path):
         import jax
 

@@ -136,6 +136,25 @@ class TestServingDemoExample:
         # the metrics sink must have streamed at least one ordered row
         assert "metrics step=" in r.stdout, r.stdout[-2000:]
 
+    @pytest.mark.slow
+    def test_replicas_path_routes_through_fleet(self):
+        # [slow: a second serving subprocess warming 2 paged replicas
+        # ≈ 25s; the fleet router itself is tier-1-covered by
+        # test_fleet.py and the single-server demo test above stays]
+        r = _run_example("examples/serving_demo.py",
+                         ["--requests", "5", "--max-slots", "2",
+                          "--replicas", "2"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert r.stdout.count("req ") == 5, r.stdout[-2000:]
+        assert "fleet: replicas=2 ready=2" in r.stdout, \
+            r.stdout[-2000:]
+        assert "done: 5 requests" in r.stdout, r.stdout[-2000:]
+        # per-replica emissions aggregate into the one fleet writer,
+        # namespaced — the printed rows carry replica<N>/ keys
+        assert "metrics step=" in r.stdout, r.stdout[-2000:]
+        assert "replica0/" in r.stdout or "replica1/" in r.stdout, \
+            r.stdout[-2000:]
+
 
 @pytest.mark.slow
 class TestLlamaGenerateExample:

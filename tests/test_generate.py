@@ -237,6 +237,11 @@ class TestGenerate:
         after = arr[4:]
         assert np.all(after == eos)
 
+    # [slow: ~12s; the top_p-disabled-is-an-exact-no-op property stays
+    # tier-1-pinned at the serving layer (dynamic sampler twin in
+    # test_serving.py::TestTopPSampling); this static-path twin runs
+    # under -m slow + on-chip]
+    @pytest.mark.slow
     def test_top_p_one_equals_plain_sampling(self):
         """top_p=1.0 must be EXACTLY plain temperature sampling (HF
         convention) — same rng, token-identical — and greedy decoding

@@ -273,6 +273,10 @@ class TestResNet:
         assert all(np.all(np.isfinite(np.asarray(g)))
                    for g in jax.tree.leaves(grads))
 
+    # [slow: ~13s of resnet compile; BN running-stat update/eval
+    # semantics stay tier-1-pinned at the op layer in
+    # test_batch_norm.py — runs under -m slow + on-chip]
+    @pytest.mark.slow
     def test_eval_mode_uses_running_stats(self, rng):
         from apex_tpu.models import resnet18
         m = resnet18(num_classes=4)
@@ -348,7 +352,11 @@ class TestTorchImport:
     GPTModel after load_torch_gpt2 — exact architectural parity
     (pre-LN, tied embeddings, Conv1D (in,out) weights)."""
 
-    @pytest.mark.parametrize("scan", [False, True])
+    # [the scan=False twin is slow-marked (~16s of torch+compile):
+    # scan=True pins the same importer parity in tier-1; the tier-1
+    # wall budget rides its edge — runs under -m slow + on-chip]
+    @pytest.mark.parametrize("scan", [
+        pytest.param(False, marks=pytest.mark.slow), True])
     def test_gpt2_logits_match_torch(self, scan):
         import dataclasses
 

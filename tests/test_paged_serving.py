@@ -118,8 +118,13 @@ class TestBlockAllocator:
 
 
 class TestGreedyParityAcrossBoundaries:
+    # [the llama twin is slow-marked: ~40s of CPU compile for the same
+    # engine property the gpt twin pins in tier-1 (GQA decode parity
+    # is separately tier-1-covered by test_generate's incremental
+    # suites); it still runs under -m slow and in the on-chip pass]
     @pytest.mark.l0
-    @pytest.mark.parametrize("which", ["gpt", "llama"])
+    @pytest.mark.parametrize("which", [
+        "gpt", pytest.param("llama", marks=pytest.mark.slow)])
     def test_engine_matches_generate(self, which, request):
         """block_size=8, chunk=4: prompt lengths straddle the page
         boundary (7/8/9), the chunk boundary (3/4/5), their common
