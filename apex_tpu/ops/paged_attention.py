@@ -35,6 +35,19 @@ pages, pad-token writes) is never read.  Physical block 0 is the
 engine's **null page** (pad writes land there); the mask makes its
 contents unreachable, so the op needs no special case for it.
 
+**Multi-query verify (speculative decoding)**: the same ``s > 1``
+chunk path scores a draft run ``[current, d_1..d_k]`` in one
+application — query ``i`` sits at ``lengths[b] + i`` and sees exactly
+the pool prefix plus the drafts written before it, i.e. the context a
+sequential decode would have given it, so per-position logits equal
+``k+1`` one-token steps bit-for-bit up to blocked-accumulation order.
+Rejection needs no cleanup here: the engine rolls its cursor back over
+the rejected tail, the stale draft K/V sits at positions past the new
+``lengths`` where this mask cannot reach it, and the next step's
+write-then-attend overwrites it.  A verify chunk is just a decode
+chunk whose ``s = 1 + spec_tokens`` — no dedicated kernel variant, no
+extra executable.
+
 Two implementations under the :mod:`apex_tpu.ops._dispatch`
 conventions:
 
@@ -233,7 +246,10 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
     module docstring).
 
     Inference-only (the decode path has no backward); the chunk's own
-    K/V must already be written into the pool.  ``implementation``
+    K/V must already be written into the pool.  ``s > 1`` serves both
+    chunked prefill and the speculative-decoding verify (one
+    application scores ``1 + spec_tokens`` draft positions — see the
+    module docstring's multi-query verify section).  ``implementation``
     follows :mod:`apex_tpu.ops._dispatch`: ``"auto"`` picks the Pallas
     kernel on TPU when the geometry fits its envelope (``block_size``
     and ``head_dim`` multiples of 8, GQA head ratio integral) and the

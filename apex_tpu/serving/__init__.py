@@ -8,7 +8,13 @@ path, in two cache layouts:
   (:mod:`~apex_tpu.serving.cache`), chunked prefill riding inside the
   fused mixed prefill+decode step, token-budget admission and
   block-exhaustion preemption — HBM footprint and per-step bytes
-  scale with live tokens, not ``max_slots × max_seq_len``;
+  scale with live tokens, not ``max_slots × max_seq_len``.  On top:
+  refcounted **copy-on-write prefix sharing** (``share_prefixes=True``
+  — a hot system prompt's KV pages are trie-matched at admission and
+  mapped once per replica instead of once per tenant) and
+  **speculative decoding** (``spec_tokens=K`` — host-side
+  prompt-lookup drafts verified K-at-a-time in one mixed-step
+  application, accepted-prefix + bonus token per step);
 - **dense** (:class:`Engine`, the fallback): the fixed
   ``max_slots × max_seq_len`` slotted slab with bucket-padded prefill.
 
@@ -43,8 +49,14 @@ from apex_tpu.serving.engine import (
     Engine,
     PagedEngine,
     StepOutput,
+    prompt_lookup_draft,
 )
-from apex_tpu.serving.cache import BlockAllocator, BlockExhausted
+from apex_tpu.serving.cache import (
+    BlockAllocator,
+    BlockExhausted,
+    PrefixTrie,
+    chain_digests,
+)
 from apex_tpu.serving.scheduler import (
     QueueFull,
     Request,
@@ -67,6 +79,9 @@ __all__ = [
     "StepOutput",
     "BlockAllocator",
     "BlockExhausted",
+    "PrefixTrie",
+    "chain_digests",
+    "prompt_lookup_draft",
     "DEFAULT_BUCKETS",
     "Scheduler",
     "Request",

@@ -199,7 +199,9 @@ class InferenceServer:
                  metrics_interval: int = 32,
                  kv_cache: str = "dense", block_size: int = 0,
                  pool_tokens: Optional[int] = None,
-                 admit_headroom: Optional[int] = None):
+                 admit_headroom: Optional[int] = None,
+                 share_prefixes: bool = False,
+                 spec_tokens: int = 0, spec_ngram: int = 3):
         if kv_cache == "paged":
             if prompt_buckets is not None:
                 raise ValueError(
@@ -213,8 +215,16 @@ class InferenceServer:
                 model, params, max_slots=max_slots,
                 block_size=block_size, pool_tokens=pool_tokens,
                 prefill_chunk=prefill_chunk or 32,
-                admit_headroom=admit_headroom)
+                admit_headroom=admit_headroom,
+                share_prefixes=share_prefixes,
+                spec_tokens=spec_tokens, spec_ngram=spec_ngram)
         elif kv_cache == "dense":
+            if share_prefixes or spec_tokens:
+                raise ValueError(
+                    "share_prefixes / spec_tokens require "
+                    "kv_cache='paged' — the dense slab has no page "
+                    "pool to share and no mixed multi-token step to "
+                    "verify drafts in")
             self.engine = Engine(
                 model, params, max_slots=max_slots,
                 prompt_buckets=(DEFAULT_BUCKETS if prompt_buckets
@@ -607,6 +617,14 @@ class InferenceServer:
             payload["blocks_in_use"] = self.engine.blocks_in_use
             payload["blocks_total"] = blocks_total
             payload["live_tokens"] = self.engine.live_tokens
+            # prefix-sharing gauges (0 when off); the accept rate only
+            # when drafting is configured — a fleet-mean over
+            # spec-disabled replicas' hardwired 0.0 would dilute it
+            payload["shared_blocks"] = self.engine.shared_blocks
+            payload["cow_forks"] = self.engine.cow_forks
+            if getattr(self.engine, "spec_tokens", 0):
+                payload["spec_accept_rate"] = \
+                    self.engine.spec_accept_rate
         self.metrics(self._steps, payload)
         self.metrics.drain()
         self._last_emit_step = self._steps
@@ -662,7 +680,18 @@ class InferenceServer:
             out["blocks_in_use"] = self.engine.blocks_in_use
             out["blocks_total"] = blocks_total
             out["live_tokens"] = self.engine.live_tokens
+            out["shared_blocks"] = self.engine.shared_blocks
+            out["cow_forks"] = self.engine.cow_forks
+            if getattr(self.engine, "spec_tokens", 0):
+                out["spec_accept_rate"] = self.engine.spec_accept_rate
         return out
+
+    def prefix_hit_blocks(self, prompt) -> int:
+        """Pages of ``prompt``'s prefix already resident in this
+        server's trie (0 for dense engines or with sharing off) — the
+        fleet router's prefix-affinity key."""
+        fn = getattr(self.engine, "prefix_hit_blocks", None)
+        return 0 if fn is None else int(fn(prompt))
 
     # ---------------------------------------------------------- telemetry
     @property

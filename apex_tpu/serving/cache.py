@@ -25,10 +25,13 @@ rejects it loudly.
 
 from __future__ import annotations
 
-from typing import Any, List, NamedTuple
+import hashlib
+from typing import Any, Dict, List, NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+import numpy as np
 
 __all__ = [
     "SlotState",
@@ -43,6 +46,8 @@ __all__ = [
     "BlockExhausted",
     "blocks_for",
     "set_paged_leaves",
+    "PrefixTrie",
+    "chain_digests",
 ]
 
 # cache leaves that hold *positions* rather than keys/values: the
@@ -230,7 +235,7 @@ def blocks_for(tokens: int, block_size: int) -> int:
 
 
 class BlockAllocator:
-    """Host-side free list over the physical page pool.
+    """Host-side refcounted free list over the physical page pool.
 
     The pool is sized in TOKENS (``num_blocks × block_size``), shared
     by every tenant — the paged tentpole's replacement for the dense
@@ -238,6 +243,15 @@ class BlockAllocator:
     reserved **null page**: unallocated block-table entries point at
     it, pad-token writes land in it, and the position mask keeps its
     contents unreachable — so it is never handed out.
+
+    Pages carry a **refcount** (the prefix-sharing substrate, ISSUE 7):
+    :meth:`alloc` hands out pages at refcount 1, :meth:`incref` lets a
+    second tenant reference the same physical page (a shared read-only
+    prompt-prefix block), and :meth:`free` *decrements* — a page
+    returns to the free list only when its last reference drops, so a
+    hot system prompt's KV is charged to the pool once no matter how
+    many tenants map it.  ``blocks_in_use`` stays EXACT under sharing:
+    it counts physical pages, never logical references.
 
     Not thread-safe: the engine-owning thread is the only caller (the
     same single-writer discipline as the engine itself).
@@ -256,6 +270,8 @@ class BlockAllocator:
         # LIFO free stack: blocks freed together are reused together
         # (keeps a tenant's pages warm in any downstream cache level)
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        #: live refcounts — only allocated pages have an entry
+        self._refs: Dict[int, int] = {}
 
     @property
     def blocks_total(self) -> int:
@@ -278,10 +294,32 @@ class BlockAllocator:
     def tokens_free(self) -> int:
         return len(self._free) * self.block_size
 
+    @property
+    def shared_blocks(self) -> int:
+        """Physical pages currently mapped by MORE than one reference
+        — the prefix-sharing win gauge (:attr:`blocks_saved` counts
+        the pool pages that sharing reclaims).  Snapshots the refcount
+        dict first: health probes read this from other threads while
+        the engine thread allocates/frees, and iterating a mutating
+        dict raises."""
+        return sum(1 for r in list(self._refs.values()) if r > 1)
+
+    @property
+    def blocks_saved(self) -> int:
+        """Pool pages sharing reclaimed: ``Σ (refcount - 1)`` — the
+        pages an unshared pool would additionally burn right now
+        (snapshot semantics, as :attr:`shared_blocks`)."""
+        return sum(r - 1 for r in list(self._refs.values()) if r > 1)
+
+    def refcount(self, block: int) -> int:
+        """Live references to ``block`` (0 = free)."""
+        return self._refs.get(int(block), 0)
+
     def alloc(self, n: int) -> List[int]:
-        """Take ``n`` pages; raises :class:`BlockExhausted` (taking
-        none) when fewer than ``n`` are free — allocation is atomic so
-        a failed extension never leaks partial pages."""
+        """Take ``n`` pages (each at refcount 1); raises
+        :class:`BlockExhausted` (taking none) when fewer than ``n``
+        are free — allocation is atomic so a failed extension never
+        leaks partial pages."""
         if n < 0:
             raise ValueError(f"n must be >= 0, got {n}")
         if n > len(self._free):
@@ -290,20 +328,131 @@ class BlockAllocator:
                 f"(pool: {self.blocks_total} × {self.block_size} tok)")
         taken = self._free[-n:] if n else []
         del self._free[len(self._free) - n:]
+        for blk in taken:
+            self._refs[blk] = 1
         return taken
 
-    def free(self, blocks) -> None:
-        """Return pages to the pool (idempotence is NOT provided —
-        double-free is a caller bug and raises)."""
+    def incref(self, block: int) -> int:
+        """Add one reference to a LIVE page (prefix sharing: a new
+        tenant maps an existing read-only prompt block).  Returns the
+        new refcount; raises on a free/out-of-range page — sharing
+        dead KV is a caller bug."""
+        blk = int(block)
+        if blk not in self._refs:
+            raise ValueError(
+                f"incref of block {blk} which is not allocated")
+        self._refs[blk] += 1
+        return self._refs[blk]
+
+    def free(self, blocks) -> List[int]:
+        """Drop one reference per page; pages whose LAST reference
+        dropped return to the pool and are listed in the return value
+        (the engine forgets them from its prefix trie).  Decrementing
+        a free page — the old double-free — still raises."""
+        freed: List[int] = []
         for blk in blocks:
             blk = int(blk)
             if not 1 <= blk < self.num_blocks:
                 raise ValueError(
                     f"block {blk} outside the allocatable range "
                     f"[1, {self.num_blocks})")
-            if blk in self._free:
+            refs = self._refs.get(blk)
+            if refs is None:
                 raise ValueError(f"double free of block {blk}")
-            self._free.append(blk)
+            if refs > 1:
+                self._refs[blk] = refs - 1
+            else:
+                del self._refs[blk]
+                self._free.append(blk)
+                freed.append(blk)
+        return freed
+
+
+# --------------------------------------------------------------------- #
+# prefix trie: block-granular prompt-prefix index over live pages
+# --------------------------------------------------------------------- #
+def chain_digests(tokens: np.ndarray, block_size: int) -> List[bytes]:
+    """Chained content digests of every FULL ``block_size`` block of
+    ``tokens``: ``digest_i = sha256(digest_{i-1} || block_i_tokens)``.
+
+    The chaining makes each digest identify the whole prefix up to and
+    including its block — two prompts share block ``i`` iff they agree
+    on every token of blocks ``0..i`` — so a flat dict over digests IS
+    a trie walk.  Content-addressed (sha256 over the raw int32 bytes):
+    collisions are cryptographically negligible, so digest equality is
+    treated as prefix equality.
+    """
+    tokens = np.ascontiguousarray(tokens, np.int32)
+    out: List[bytes] = []
+    digest = b"apex-tpu-prefix-v1"
+    for i in range(tokens.size // int(block_size)):
+        h = hashlib.sha256(digest)
+        h.update(tokens[i * block_size:(i + 1) * block_size].tobytes())
+        digest = h.digest()
+        out.append(digest)
+    return out
+
+
+class PrefixTrie:
+    """Digest → physical page index of LIVE read-only prompt blocks.
+
+    The admission-time half of copy-on-write prefix sharing
+    (:class:`~apex_tpu.serving.engine.PagedEngine`): a tenant that
+    finishes prefilling a full prompt block :meth:`register`\\ s its
+    page under the block's chain digest; a later admission
+    :meth:`match`\\ es its own prompt's digests against the trie and
+    maps the hit pages instead of recomputing (and re-storing) their
+    KV.  Entries are removed by :meth:`forget` when the underlying
+    page's last reference drops — the trie only ever points at live
+    pool pages, so a hit can always be increfed.
+    """
+
+    def __init__(self):
+        self._by_digest: Dict[bytes, int] = {}
+        self._by_block: Dict[int, bytes] = {}
+
+    def __len__(self) -> int:
+        return len(self._by_digest)
+
+    def register(self, digest: bytes, block: int) -> bool:
+        """Index ``block`` under ``digest``; first writer wins (a
+        concurrent tenant prefilling the same prompt keeps its private
+        duplicate unregistered).  Returns whether the entry was
+        added."""
+        if digest in self._by_digest:
+            return False
+        block = int(block)
+        if block in self._by_block:
+            # one physical page per digest AND per block: re-keying a
+            # live page would leave a stale digest→block entry behind
+            return False
+        self._by_digest[digest] = block
+        self._by_block[block] = digest
+        return True
+
+    def forget(self, block: int) -> None:
+        """Drop the entry for a page returning to the free list (a
+        no-op for unregistered pages)."""
+        digest = self._by_block.pop(int(block), None)
+        if digest is not None:
+            del self._by_digest[digest]
+
+    def holds_block(self, block: int) -> bool:
+        """Whether ``block`` is indexed (and therefore read-only for
+        its current owner)."""
+        return int(block) in self._by_block
+
+    def match(self, digests: List[bytes]) -> List[int]:
+        """Longest-prefix hit: the physical pages for the leading run
+        of ``digests`` present in the trie (chain digests make any
+        hit's whole prefix a hit too)."""
+        pages: List[int] = []
+        for digest in digests:
+            block = self._by_digest.get(digest)
+            if block is None:
+                break
+            pages.append(block)
+        return pages
 
 
 def set_paged_leaves(cache: Any, tables, cursors) -> Any:

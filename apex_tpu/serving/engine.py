@@ -56,9 +56,10 @@ from apex_tpu.models.generate import (
 )
 from apex_tpu.serving import cache as slot_cache
 from apex_tpu.utils import tracecheck
+from apex_tpu.utils.metrics import counters
 
 __all__ = ["Engine", "PagedEngine", "StepOutput", "sample_dynamic",
-           "DEFAULT_BUCKETS"]
+           "prompt_lookup_draft", "DEFAULT_BUCKETS"]
 
 DEFAULT_BUCKETS: Tuple[int, ...] = (32, 128, 512)
 
@@ -66,9 +67,12 @@ DEFAULT_BUCKETS: Tuple[int, ...] = (32, 128, 512)
 class StepOutput(NamedTuple):
     """One engine step's host-visible result.
 
-    ``tokens``/``finished`` are length-``max_slots`` numpy arrays as in
-    the dense engine; ``emitted[i]`` marks slots whose token is REAL
-    this step (a mid-prefill tenant computes but emits nothing);
+    ``tokens`` is ``(max_slots, width)`` — a speculative verify step
+    can emit several tokens per slot per step; ``counts[i]`` says how
+    many of row i's tokens are REAL this step (``tokens[i, :counts[i]]``,
+    in emission order; 0 for a mid-prefill tenant, which computes but
+    emits nothing).  ``finished[i]`` latches on row i's LAST emitted
+    token; ``emitted`` is the legacy ``counts > 0`` mask.
     ``preempted`` lists slots the engine evicted for block exhaustion
     before the step ran — their tenants' blocks and slot state are
     already released, and the scheduler requeues them to continue from
@@ -79,6 +83,40 @@ class StepOutput(NamedTuple):
     finished: np.ndarray
     emitted: np.ndarray
     preempted: Tuple[int, ...]
+    counts: np.ndarray
+
+
+def prompt_lookup_draft(context: np.ndarray, k: int,
+                        max_ngram: int = 3) -> np.ndarray:
+    """Propose up to ``k`` draft tokens by PROMPT LOOKUP (n-gram
+    continuation) — the model-free drafter of the speculative-decoding
+    tentpole.
+
+    Finds the most recent earlier occurrence of the context's trailing
+    n-gram (longest ``n <= max_ngram`` first) and proposes the tokens
+    that followed it.  Pure host-side numpy over ``prompt ++ streamed
+    tokens``; returns an empty array when nothing matches — the row
+    then rides the step as a plain one-token decode.  Summarization /
+    code-editing / few-shot traffic repeats long prompt spans, which
+    is exactly when lookup drafts hit ("LLM Inference Acceleration via
+    Efficient Operation Fusion", PAPERS.md reports the same
+    no-second-model recipe).
+    """
+    context = np.asarray(context, np.int32).reshape(-1)
+    n_ctx = int(context.size)
+    if k < 1 or n_ctx < 2:
+        return np.empty((0,), np.int32)
+    for n in range(min(int(max_ngram), n_ctx - 1), 0, -1):
+        pattern = context[n_ctx - n:]
+        windows = np.lib.stride_tricks.sliding_window_view(
+            context[:n_ctx - 1], n)
+        hits = np.nonzero((windows == pattern).all(axis=1))[0]
+        if hits.size:
+            start = int(hits[-1]) + n
+            drafts = context[start:start + int(k)]
+            if drafts.size:
+                return drafts.astype(np.int32)
+    return np.empty((0,), np.int32)
 
 
 def _check_sampling(vocab_size: int, top_k, top_p) -> None:
@@ -317,11 +355,13 @@ class Engine:
         del temperature      # any float is admissible (<=0 -> greedy)
         return bucket
 
-    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+    def can_admit(self, prompt_len: int, max_new_tokens: int,
+                  prompt=None) -> bool:
         """Dense pool: the slab reserves worst-case room per slot, so
         a free slot is always admissible (the scheduler gates on slot
-        availability; the paged engine gates on free blocks here)."""
-        del prompt_len, max_new_tokens
+        availability; the paged engine gates on free blocks — shared-
+        prefix-discounted — here)."""
+        del prompt_len, max_new_tokens, prompt
         return True
 
     def admit(self, slot: int, prompt, *, max_new_tokens: int,
@@ -403,6 +443,12 @@ class _Tenant:
     cursor: int = 0             # tokens written into the cache
     blocks: List[int] = dataclasses.field(default_factory=list)
     seq: int = 0                # admission order (LIFO preemption key)
+    budget: int = 0             # max_new_tokens (host mirror)
+    emitted: int = 0            # tokens emitted so far (host mirror)
+    gen: List[int] = dataclasses.field(default_factory=list)
+    #: chain digests of the prompt's full blocks (prefix sharing)
+    digests: List[bytes] = dataclasses.field(default_factory=list)
+    registered: int = 0         # prompt blocks offered to the trie
 
 
 class PagedEngine:
@@ -427,19 +473,54 @@ class PagedEngine:
       engine's per-slot vmap, and attention goes through
       :func:`apex_tpu.ops.paged_attention`.
 
-    Exactly FOUR executables for the process lifetime, each under an
-    exact :func:`~apex_tpu.utils.tracecheck.retrace_guard` budget of 1:
+    Exactly FOUR executables for the process lifetime — FIVE with
+    speculative decoding on — each under an exact
+    :func:`~apex_tpu.utils.tracecheck.retrace_guard` budget of 1:
     ``decode_step`` (width-1 step), ``prefill_step`` (the width-
     ``prefill_chunk`` mixed step — the dense engine's per-bucket
-    prefills collapse to this one shape), ``admit`` (slot-state
-    scatter; no cache writes — pages are overwritten before they become
-    visible, so admission and release never touch the pool), and
-    ``release``.
+    prefills collapse to this one shape), the optional ``spec_step``
+    (the width-``1 + spec_tokens`` draft/verify step below), ``admit``
+    (slot-state scatter; no cache writes — pages are overwritten
+    before they become visible, so admission and release never touch
+    the pool), and ``release``.
 
     Block exhaustion preempts the YOUNGEST tenant (its blocks are
     freed, its slot state cleared) and reports it in
     ``StepOutput.preempted``; the scheduler requeues it to continue
     from its streamed prefix (PR 4's fault-recovery machinery).
+
+    **Prefix sharing (``share_prefixes=True``)**: admission hashes the
+    prompt block-by-block (:func:`~apex_tpu.serving.cache.
+    chain_digests`) against a :class:`~apex_tpu.serving.cache.
+    PrefixTrie` of live read-only prompt pages.  Hits are mapped
+    refcounted (:meth:`BlockAllocator.incref`) instead of recomputed:
+    the tenant's ``fed``/``cursor`` start past the shared prefix, so a
+    hot system prompt costs the pool — and the prefill compute — once
+    per replica instead of once per tenant.  Only FULL prompt blocks
+    are shared and a tenant always re-feeds at least its final prompt
+    token (the logits source); when the trie covers the whole prompt,
+    the last matched block is **copy-on-write forked**: the tenant
+    takes a private page and re-derives the block's KV by re-feeding
+    its tokens through the ordinary prefill step (copy-by-recompute —
+    bitwise identical, no extra executable), counted on ``cow_forks``.
+    Eviction/preemption *decrement* refcounts; a page returns to the
+    pool — and drops out of the trie — only when its last tenant
+    leaves, so ``blocks_in_use`` stays exact and drains to 0.
+
+    **Speculative decoding (``spec_tokens=K > 0``)**: a host-side
+    prompt-lookup drafter (:func:`prompt_lookup_draft` — no second
+    model) proposes up to K tokens per decoding row from the tenant's
+    own ``prompt ++ streamed`` context; the ``spec_step`` feeds
+    ``[current, d_1..d_k]`` through ONE model application (the
+    chunked-prefill machinery already handles multi-token rows at
+    arbitrary positions), samples at every position with sequentially
+    split per-row keys, accepts the longest draft prefix matching the
+    sampled chain plus one bonus token, and rolls the host cursor back
+    over rejected tails (their pool writes are position-masked garbage
+    the next step overwrites).  The rng advance is emission-gated *per
+    emitted token* — the k-th produced token always consumes the k-th
+    split — so greedy AND sampled chains are token-identical to
+    ``generate()`` regardless of the acceptance pattern.
 
     ``block_size=0`` consults the
     :mod:`~apex_tpu.ops.autotune` table (op ``"paged_attention"``,
@@ -455,7 +536,10 @@ class PagedEngine:
                  block_size: int = 0,
                  pool_tokens: Optional[int] = None,
                  prefill_chunk: int = 32,
-                 admit_headroom: Optional[int] = None):
+                 admit_headroom: Optional[int] = None,
+                 share_prefixes: bool = False,
+                 spec_tokens: int = 0,
+                 spec_ngram: int = 3):
         cfg = getattr(model, "cfg", None)
         if cfg is None or not hasattr(cfg, "max_seq_len"):
             raise ValueError(
@@ -475,11 +559,24 @@ class PagedEngine:
         if prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0, got {spec_tokens}")
+        if spec_ngram < 1:
+            raise ValueError(
+                f"spec_ngram must be >= 1, got {spec_ngram}")
         self.model = model
         self.max_slots = int(max_slots)
         self.max_seq_len = int(cfg.max_seq_len)
         self.vocab_size = int(cfg.vocab_size)
         self._chunk = int(prefill_chunk)
+        self.share_prefixes = bool(share_prefixes)
+        self.spec_tokens = int(spec_tokens)
+        self.spec_ngram = int(spec_ngram)
+        #: the drafter — swapped for a forced-draft stub during warmup
+        #: so the spec executable is traced even when the dummy context
+        #: has no n-gram hit
+        self._drafter = prompt_lookup_draft
         if block_size == 0:
             from apex_tpu.ops import autotune
             block_size = autotune.cached_block_rows(
@@ -493,8 +590,11 @@ class PagedEngine:
             pool_tokens = self.max_slots * self.max_seq_len
         # the pool bounds the largest ADMISSIBLE request
         # (validate_request rejects anything that could never fit
-        # alone); the floor here only covers the warmup tenant
-        min_tokens = min(self._chunk + 3, self.max_seq_len)
+        # alone); the floor here only covers the warmup tenants — the
+        # drafted warmup pass admits chunk+1 prompt tokens with a
+        # 2 + spec_tokens budget, so the floor grows with K
+        min_tokens = min(self._chunk + 3 + self.spec_tokens,
+                         self.max_seq_len)
         if pool_tokens < min_tokens:
             raise ValueError(
                 f"pool_tokens ({pool_tokens}) must cover at least the "
@@ -503,6 +603,11 @@ class PagedEngine:
                                            self.block_size) + 1
         self._alloc = slot_cache.BlockAllocator(num_blocks,
                                                 self.block_size)
+        self._trie = slot_cache.PrefixTrie()
+        #: lifetime counters (gauges ride health()/metrics)
+        self.cow_forks = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self._headroom = (2 * self.block_size if admit_headroom is None
                           else int(admit_headroom))
         self._variables = dict(params)
@@ -563,6 +668,70 @@ class PagedEngine:
                 rng=jnp.where(emit[:, None], split[:, 1], state.rng))
             return cache, state, nxt, finished
 
+        spec_w = 1 + self.spec_tokens
+
+        def spec_step_fn(variables, cache, state, tables, cursors,
+                         feed, n_tokens, emit):
+            # the draft/verify step: every active row decodes — feed
+            # row i is [current_tok, d_1..d_k, pad] with n_tokens[i] =
+            # 1 + k real tokens.  ONE model application scores all
+            # positions; write-then-attend puts the drafts' K/V in the
+            # pool first, and the absolute-position mask gives each
+            # draft exactly its sequential context.
+            cache = slot_cache.set_paged_leaves(cache, tables, cursors)
+            ids = feed.at[:, 0].set(state.tok)
+            logits, cache = apply_decode(model, variables, cache, ids)
+            # sequential rng chain: position j samples with the j-th
+            # split of the row's key — identical keys to j one-token
+            # steps, which is what makes sampled chains
+            # acceptance-invariant
+            chain = state.rng
+            keys, chains = [], [chain]
+            for _ in range(spec_w):
+                split = jax.vmap(jax.random.split)(chain)
+                keys.append(split[:, 0])
+                chain = split[:, 1]
+                chains.append(chain)
+            sampled = jnp.stack([
+                sample_dynamic(logits[:, j], keys[j], state.temperature,
+                               state.top_k, state.top_p, vocab)
+                for j in range(spec_w)], axis=1)      # (slots, w)
+            idx = jnp.arange(spec_w, dtype=jnp.int32)
+            # draft j+1 accepted iff it equals the token the model
+            # would have sampled at its position — the longest
+            # accepted prefix reproduces the sequential chain exactly
+            match = (sampled[:, :-1] == feed[:, 1:]) \
+                & (idx[None, 1:] < n_tokens[:, None])
+            accept = jnp.sum(jnp.cumprod(
+                match.astype(jnp.int32), axis=1), axis=1)
+            n_emit = jnp.minimum(accept + 1, n_tokens)
+            eos_hit = (state.eos_id[:, None] >= 0) \
+                & (sampled == state.eos_id[:, None])
+            eos_pos = jnp.min(jnp.where(eos_hit, idx[None, :], spec_w),
+                              axis=1)
+            n_emit = jnp.minimum(n_emit, eos_pos + 1)
+            remaining = jnp.maximum(state.budget - state.produced, 0)
+            n_emit = jnp.minimum(n_emit, remaining)
+            n_emit = jnp.where(emit & state.active, n_emit, 0)
+            produced = state.produced + n_emit
+            hit_budget = produced >= state.budget
+            hit_eos = eos_pos < n_emit
+            finished = (n_emit > 0) & (hit_budget | hit_eos)
+            last = jnp.take_along_axis(
+                sampled, jnp.maximum(n_emit - 1, 0)[:, None],
+                axis=1)[:, 0]
+            # rng advance is emission-gated per TOKEN: exactly n_emit
+            # splits are consumed, like n_emit one-token steps
+            new_rng = jnp.take_along_axis(
+                jnp.stack(chains, axis=1), n_emit[:, None, None],
+                axis=1)[:, 0]
+            state = state._replace(
+                tok=jnp.where(n_emit > 0, last, state.tok),
+                produced=produced,
+                active=state.active & ~finished,
+                rng=new_rng)
+            return cache, state, sampled, n_emit, finished
+
         def admit(state, slot, tok, budget, temperature, top_k, top_p,
                   eos_id, seed):
             return slot_cache.admit_slot(
@@ -572,7 +741,7 @@ class PagedEngine:
         def release(state, slot):
             return slot_cache.release_slot(state, slot)
 
-        # exact budgets: decode/admit/release = 1 and the dense
+        # exact budgets: decode/spec/admit/release = 1 and the dense
         # engine's per-bucket prefills collapse to ONE mixed-step
         # shape — any excess trace raises RetraceError
         self._decode = tracecheck.retrace_guard(
@@ -580,6 +749,9 @@ class PagedEngine:
             donate_argnums=(1, 2))
         self._prefill = tracecheck.retrace_guard(
             step_fn, max_traces=1, name="serving.prefill_step",
+            donate_argnums=(1, 2))
+        self._spec = tracecheck.retrace_guard(
+            spec_step_fn, max_traces=1, name="serving.spec_step",
             donate_argnums=(1, 2))
         self._admit = tracecheck.retrace_guard(
             admit, max_traces=1, name="serving.admit",
@@ -616,13 +788,41 @@ class PagedEngine:
         _check_sampling(self.vocab_size, top_k, top_p)
         del temperature
 
-    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+    def _sharable_blocks(self, prompt: np.ndarray,
+                         digests: Optional[List[bytes]] = None) -> int:
+        """Trie-matched prompt blocks this prompt could map, CAPPED so
+        at least the final prompt token is always re-fed (the logits
+        source): a whole-prompt hit drops its last block — that block
+        is re-derived into a private page (the copy-on-write fork)."""
+        if not self.share_prefixes:
+            return 0
+        if digests is None:
+            digests = slot_cache.chain_digests(prompt, self.block_size)
+        matched = len(self._trie.match(digests))
+        return min(matched,
+                   (int(prompt.size) - 1) // self.block_size)
+
+    def prefix_hit_blocks(self, prompt) -> int:
+        """Pages of ``prompt``'s prefix already resident in this
+        engine's trie (0 with sharing off) — the fleet router's
+        prefix-affinity routing key, and the admission discount."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        return self._sharable_blocks(prompt)
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int,
+                  prompt=None) -> bool:
         """Token-budget admission gate: free pages must cover the
         prompt plus reserved decode headroom (preemption backstops the
-        deliberate overcommit beyond the headroom)."""
+        deliberate overcommit beyond the headroom).  SHARED-aware when
+        the caller passes the prompt tokens: trie-resident prefix
+        pages cost nothing new, so reclaimed pool capacity converts
+        directly into admitted occupancy."""
+        shared = 0
+        if prompt is not None and self.share_prefixes:
+            shared = self.prefix_hit_blocks(prompt)
         need = slot_cache.blocks_for(
             prompt_len + min(int(max_new_tokens), self._headroom),
-            self.block_size)
+            self.block_size) - shared
         return self._alloc.blocks_free >= need
 
     def admit(self, slot: int, prompt, *, max_new_tokens: int,
@@ -632,7 +832,10 @@ class PagedEngine:
         """Install one request into a free slot.  NO prefill happens
         here — the prompt rides the next steps as chunks; no pages are
         allocated either (the step loop extends tables just ahead of
-        the tokens it writes)."""
+        the tokens it writes).  With ``share_prefixes``, trie-resident
+        prompt-prefix pages ARE mapped here (refcounted, read-only):
+        ``fed``/``cursor`` start past them, so their KV is neither
+        recomputed nor re-stored."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.validate_request(prompt.shape[0], max_new_tokens,
                               temperature, top_k, top_p)
@@ -644,8 +847,29 @@ class PagedEngine:
                              "admission never silently replaces — the "
                              "tenant owns pool pages)")
         self._admit_seq += 1
-        self._tenants[slot] = _Tenant(prompt=prompt,
-                                      seq=self._admit_seq)
+        rec = _Tenant(prompt=prompt, seq=self._admit_seq,
+                      budget=int(max_new_tokens))
+        if self.share_prefixes:
+            rec.digests = slot_cache.chain_digests(prompt,
+                                                   self.block_size)
+            matched = self._trie.match(rec.digests)
+            # same cap as _sharable_blocks, without a second trie walk
+            n_share = min(len(matched),
+                          (int(prompt.size) - 1) // self.block_size)
+            if len(matched) > n_share:
+                # whole-prompt hit: the dropped tail block will be
+                # re-derived into a private page (CoW fork by
+                # recompute — see the class docstring)
+                self.cow_forks += 1
+                counters.inc("serving.cow_fork")
+            for page in matched[:n_share]:
+                self._alloc.incref(page)
+            rec.blocks = list(matched[:n_share])
+            self._tables[slot, :n_share] = rec.blocks
+            rec.fed = rec.cursor = n_share * self.block_size
+            rec.registered = n_share
+            self._cursors[slot] = rec.cursor
+        self._tenants[slot] = rec
         self.state = self._admit(
             self.state, np.int32(slot), np.int32(prompt[-1]),
             np.int32(max_new_tokens), np.float32(temperature),
@@ -665,11 +889,21 @@ class PagedEngine:
         garbage unreachable."""
         rec = self._tenants[slot]
         if rec is not None:
-            self._alloc.free(rec.blocks)
+            # refcounted free: shared prefix pages survive until their
+            # LAST tenant leaves; pages that actually returned to the
+            # pool drop out of the trie (it only indexes live KV)
+            for page in self._alloc.free(rec.blocks):
+                self._trie.forget(page)
             self._tables[slot] = 0
             self._cursors[slot] = 0
             self._tenants[slot] = None
         self.state = self._release(self.state, np.int32(slot))
+
+    def _read_only(self, page: int) -> bool:
+        """A page no tenant may write: mapped by >1 tenant, or indexed
+        by the trie (a future tenant may map it any time)."""
+        return (self._alloc.refcount(page) > 1
+                or self._trie.holds_block(page))
 
     def _extend(self, slot: int, n: int,
                 preempted: List[int]) -> None:
@@ -677,8 +911,37 @@ class PagedEngine:
         tokens, preempting the youngest tenant on exhaustion.  A
         request is admission-validated to fit the whole pool alone, so
         the loop terminates: in the worst case everyone else (and
-        finally the needy slot itself) is preempted."""
+        finally the needy slot itself) is preempted.
+
+        Copy-on-write guard: the write range must never touch a
+        READ-ONLY page.  By construction it cannot land mid-block in
+        one (admission always leaves shared prefixes at a block
+        boundary and re-derives a whole-prompt hit's tail block), so
+        the only live case is an exact-boundary fork — swap in a fresh
+        private page with nothing to copy — and exhaustion there
+        preempts through the same loop as a plain extension."""
         rec = self._tenants[slot]
+        while rec is not None and rec.cursor % self.block_size == 0:
+            wb = rec.cursor // self.block_size
+            if wb >= len(rec.blocks) \
+                    or not self._read_only(rec.blocks[wb]):
+                break
+            try:
+                got = self._alloc.alloc(1)
+            except slot_cache.BlockExhausted:
+                victim = self._youngest()
+                self._free_tenant(victim)
+                preempted.append(victim)
+                if victim == slot:
+                    return
+                continue
+            for page in self._alloc.free([rec.blocks[wb]]):
+                self._trie.forget(page)
+            rec.blocks[wb] = got[0]
+            self._tables[slot, wb] = got[0]
+            self.cow_forks += 1
+            counters.inc("serving.cow_fork")
+            break
         while rec is not None:
             # capped at the table width: a finished-but-unreleased
             # tenant stepped past max_seq_len (possible in raw engine
@@ -702,22 +965,64 @@ class PagedEngine:
             self._tables[slot, start:start + len(got)] = got
             rec.blocks.extend(got)
 
+    def _register_blocks(self, rec: _Tenant) -> None:
+        """Offer a prefilling tenant's newly COMPLETED full prompt
+        blocks to the trie: from the moment a block's last prompt
+        token is fed (and therefore written), its page is finalized
+        read-only KV any same-prefix admission may map."""
+        full = min(int(rec.fed), int(rec.prompt.size)) \
+            // self.block_size
+        limit = min(full, len(rec.digests))
+        while rec.registered < limit:
+            i = rec.registered
+            self._trie.register(rec.digests[i], rec.blocks[i])
+            rec.registered += 1
+
+    def _plan_drafts(self) -> List[Optional[np.ndarray]]:
+        """Host-side draft proposal for every decoding row: up to
+        ``spec_tokens`` prompt-lookup tokens, capped by the remaining
+        budget (an accepted run emits ``drafts + 1`` tokens) and the
+        cache envelope (every fed token is written at
+        ``cursor + offset``)."""
+        drafts: List[Optional[np.ndarray]] = [None] * self.max_slots
+        for slot, rec in enumerate(self._tenants):
+            if rec is None:
+                continue
+            cap = min(self.spec_tokens,
+                      rec.budget - rec.emitted - 1,
+                      self.max_seq_len - rec.cursor - 1)
+            if cap < 1:
+                continue
+            context = rec.prompt
+            if rec.gen:
+                context = np.concatenate(
+                    [context, np.asarray(rec.gen, np.int32)])
+            proposal = self._drafter(context, cap, self.spec_ngram)
+            if proposal.size:
+                drafts[slot] = proposal[:cap]
+        return drafts
+
     def step(self) -> StepOutput:
         """One fused mixed prefill+decode step over every slot.
 
         Prefilling tenants consume their next prompt chunk (emitting a
         token only on the final chunk — that token IS the first
         generated one, sampled straight from the prefill logits);
-        decoding tenants advance one token.  Inactive slots compute
-        garbage into the null page.  The single per-step host sync
-        lives here.
+        decoding tenants advance one token — or, in a drafted step
+        (``spec_tokens > 0``, no prefill pending, at least one lookup
+        hit), verify their draft run and emit the accepted prefix plus
+        one bonus token.  Inactive slots compute garbage into the null
+        page.  The single per-step host sync lives here.
         """
-        w = 1
-        for rec in self._tenants:
-            if rec is not None and rec.fed < rec.prompt.size:
-                w = self._chunk
-                break
-        any_prefill = w == self._chunk
+        any_prefill = any(rec is not None
+                          and rec.fed < rec.prompt.size
+                          for rec in self._tenants)
+        drafts: List[Optional[np.ndarray]] = [None] * self.max_slots
+        if not any_prefill and self.spec_tokens > 0:
+            drafts = self._plan_drafts()
+        any_spec = any(d is not None for d in drafts)
+        w = (self._chunk if any_prefill
+             else 1 + self.spec_tokens if any_spec else 1)
         feed = np.zeros((self.max_slots, w), np.int32)
         n_tokens = np.ones((self.max_slots,), np.int32)
         is_prefill = np.zeros((self.max_slots,), bool)
@@ -735,49 +1040,109 @@ class PagedEngine:
                 emit[slot] = rec.fed + n >= rec.prompt.size
             else:
                 emit[slot] = True
+                if drafts[slot] is not None:
+                    d = drafts[slot]
+                    feed[slot, 1:1 + d.size] = d
+                    n_tokens[slot] = 1 + d.size
             self._extend(slot, int(n_tokens[slot]), preempted)
         for slot in preempted:
             feed[slot] = 0
             n_tokens[slot] = 1
             is_prefill[slot] = False
             emit[slot] = False
-        runner = self._prefill if any_prefill else self._decode
-        self.cache, self.state, toks, finished = runner(
-            self._variables, self.cache, self.state, self._tables,
-            self._cursors, feed, n_tokens, is_prefill, emit)
+            drafts[slot] = None
+        if any_spec:
+            self.cache, self.state, sampled, n_emit, finished = \
+                self._spec(self._variables, self.cache, self.state,
+                           self._tables, self._cursors, feed,
+                           n_tokens, emit)
+            tokens = np.asarray(sampled)
+            counts = np.asarray(n_emit)
+        else:
+            runner = self._prefill if any_prefill else self._decode
+            self.cache, self.state, toks, finished = runner(
+                self._variables, self.cache, self.state, self._tables,
+                self._cursors, feed, n_tokens, is_prefill, emit)
+            tokens = np.asarray(toks)[:, None]
+            counts = emit.astype(np.int32)
         for slot in range(self.max_slots):
             rec = self._tenants[slot]
             if rec is None:
                 continue
-            n = int(n_tokens[slot])
-            if is_prefill[slot]:
-                rec.fed += n
-            rec.cursor += n
+            if any_spec:
+                # keep only the verified prefix: the cursor rolls back
+                # over rejected draft tails, whose pool writes are
+                # position-masked garbage the next step overwrites
+                kept = int(counts[slot])
+                rec.cursor += kept
+                proposed = int(n_tokens[slot]) - 1
+                if proposed > 0:
+                    self.spec_proposed += proposed
+                    self.spec_accepted += max(kept - 1, 0)
+            else:
+                n = int(n_tokens[slot])
+                if is_prefill[slot]:
+                    rec.fed += n
+                    if self.share_prefixes:
+                        self._register_blocks(rec)
+                rec.cursor += n
+            # host mirrors of the emission (the drafter's context and
+            # budget cap)
+            kept = int(counts[slot])
+            if kept:
+                rec.emitted += kept
+                rec.gen.extend(int(t) for t in tokens[slot, :kept])
             self._cursors[slot] = rec.cursor
-        return StepOutput(np.asarray(toks), np.asarray(finished),
-                          emit, tuple(preempted))
+        return StepOutput(tokens, np.asarray(finished),
+                          counts > 0, tuple(preempted), counts)
 
     def release(self, slot: int) -> None:
-        """Free ``slot``: pages back to the pool, state cleared."""
+        """Free ``slot``: pages back to the pool (refcount-decremented
+        — shared prefix pages survive their co-tenants), state
+        cleared."""
         self._free_tenant(slot)
 
     def warmup(self) -> None:
-        """Trace all four executables: one dummy tenant whose prompt
-        spans a full chunk plus a remainder (mixed prefill step), then
-        one pure decode step.  Steady state over ANY request mix is
-        retrace-free afterwards — and guarded.
+        """Trace every executable: one dummy tenant whose prompt spans
+        a full chunk plus a remainder (mixed prefill step) and then
+        decodes (width-1 step); with ``spec_tokens`` on, a second
+        tenant runs under a forced-draft stub so the drafted step is
+        traced even though the dummy context has no n-gram hit.
+        Steady state over ANY request mix is retrace-free afterwards —
+        and guarded.
 
-        The prompt clamps to ``max_seq_len - 2`` for small-context
-        models (chunk width larger than the context is legal: real
-        chunks are capped by the prompt; the executable widths traced
-        are the same either way)."""
-        plen = min(self._chunk + 1, self.max_seq_len - 2)
-        self.admit(0, np.zeros((plen,), np.int32), max_new_tokens=2)
-        while self._tenants[0] is not None:
-            out = self.step()
-            if bool(out.finished[0]):
-                break
-        self.release(0)
+        Prompts clamp for small-context models (chunk width larger
+        than the context is legal: real chunks are capped by the
+        prompt; the executable widths traced are the same either
+        way)."""
+        drafter = self._drafter
+
+        def run_one(plen: int, budget: int) -> None:
+            self.admit(0, np.zeros((plen,), np.int32),
+                       max_new_tokens=budget)
+            while self._tenants[0] is not None:
+                out = self.step()
+                if bool(out.finished[0]):
+                    break
+            self.release(0)
+
+        try:
+            # pass 1: prefill + plain decode (drafts suppressed so the
+            # width-1 executable is the one traced)
+            self._drafter = lambda context, k, ngram: np.empty(
+                (0,), np.int32)
+            run_one(max(1, min(self._chunk + 1, self.max_seq_len - 2)),
+                    2)
+            if self.spec_tokens:
+                # pass 2: forced drafts so the spec executable traces
+                self._drafter = lambda context, k, ngram: np.zeros(
+                    (k,), np.int32)
+                run_one(
+                    max(1, min(self._chunk + 1,
+                               self.max_seq_len - 2 - self.spec_tokens)),
+                    2 + self.spec_tokens)
+        finally:
+            self._drafter = drafter
 
     # ------------------------------------------------------------ gauges
     @property
@@ -806,11 +1171,40 @@ class PagedEngine:
                        if t is not None))
 
     @property
+    def shared_blocks(self) -> int:
+        """Physical pages currently mapped by more than one tenant."""
+        return self._alloc.shared_blocks
+
+    @property
+    def blocks_saved(self) -> int:
+        """Pool pages prefix sharing reclaims right now (Σ ref-1)."""
+        return self._alloc.blocks_saved
+
+    @property
+    def trie_blocks(self) -> int:
+        """Live pages indexed by the prefix trie (sharable)."""
+        return len(self._trie)
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Fraction of proposed draft tokens the verify step accepted
+        (lifetime; 0.0 before any drafted step)."""
+        if not self.spec_proposed:
+            return 0.0
+        return self.spec_accepted / self.spec_proposed
+
+    @property
     def trace_counts(self) -> dict:
-        """Observed traces per executable (diagnostics / tests)."""
-        return {
+        """Observed traces per executable (diagnostics / tests).  The
+        ``spec_step`` entry appears only when speculative decoding is
+        configured — the documented budget is 4 executables, + 1 with
+        drafting on."""
+        out = {
             "decode_step": self._decode.trace_count,
             "prefill_step": self._prefill.trace_count,
             "admit": self._admit.trace_count,
             "release": self._release.trace_count,
         }
+        if self.spec_tokens:
+            out["spec_step"] = self._spec.trace_count
+        return out

@@ -242,19 +242,28 @@ def load_score(health: Mapping[str, Any]) -> float:
 
 
 def select_replica(
-        healths: Sequence[Optional[Mapping[str, Any]]]) -> int:
+        healths: Sequence[Optional[Mapping[str, Any]]],
+        affinity: Optional[Sequence[int]] = None) -> int:
     """Index of the least-loaded ready replica, or -1 when none is.
 
     ``healths[i]`` is replica i's ``health()`` dict, or ``None`` for a
     replica the caller already excluded (ejected, draining, dead).
-    Ranking: :func:`load_score` ascending, then ``queue_depth``, then
-    index (stable under ties)."""
+    Ranking: :func:`load_score` ascending, then **prefix affinity**
+    descending (``affinity[i]`` = trie-resident prefix pages of the
+    request on replica i — a hit replica serves the request without
+    recomputing or re-storing the shared prompt's KV), then
+    ``queue_depth``, then index (stable under ties).  Affinity is a
+    TIE-BREAK below load: it concentrates a hot prompt's tenants
+    where its pages live, but never overrides least-loaded placement
+    (no hot-prompt replica meltdown); with no ``affinity`` the
+    pre-ISSUE-7 ordering is unchanged."""
     best = -1
     best_key = None
     for i, h in enumerate(healths):
         if not h or not h.get("ready"):
             continue
-        key = (load_score(h), int(h.get("queue_depth", 0)), i)
+        hit = 0 if affinity is None else int(affinity[i])
+        key = (load_score(h), -hit, int(h.get("queue_depth", 0)), i)
         if best_key is None or key < best_key:
             best, best_key = i, key
     return best
@@ -602,22 +611,35 @@ class FleetRouter:
         return rec.handle
 
     # ---------------------------------------------------------- routing
-    def _select(self, excluded) -> Optional[_Replica]:
+    def _select(self, excluded,
+                prompt=None) -> Optional[_Replica]:
         """Least-loaded routable replica (health probed fresh), or
-        ``None``."""
+        ``None``.  ``prompt`` (the request's ``original ++ streamed``
+        tokens) feeds the prefix-affinity tie-break: a replica whose
+        trie already holds the prompt's prefix pages wins ties, so a
+        hot system prompt's tenants converge where its KV lives — the
+        routing hook PR 6 left open."""
         with self._lock:
             candidates = [r for r in self._live()
                           if r.breaker.routable
                           and r.index not in excluded]
             n = len(self._replicas)
         healths: List[Optional[Dict[str, Any]]] = [None] * n
+        affinity = [0] * n
         for rep in candidates:
             try:
                 healths[rep.index] = rep.server.health()
             except Exception:               # noqa: BLE001 — a replica
                 healths[rep.index] = None   # too broken to probe is
                 continue                    # simply not a candidate
-        index = select_replica(healths)
+            if prompt is not None:
+                try:
+                    affinity[rep.index] = int(getattr(
+                        rep.server, "prefix_hit_blocks",
+                        lambda _p: 0)(prompt))
+                except Exception:           # noqa: BLE001 — affinity
+                    affinity[rep.index] = 0  # is advisory, never fatal
+        index = select_replica(healths, affinity)
         return None if index < 0 else self._replicas[index]
 
     def _dispatch(self, rec: _FleetRequest, *,
@@ -658,7 +680,7 @@ class FleetRouter:
                 last = exc
                 counters.inc("fleet.route_fault")
                 continue
-            target = self._select(excluded)
+            target = self._select(excluded, prompt)
             if target is None:
                 # every replica excluded or unroutable — clear the
                 # per-round exclusions (a replica may have recovered)
@@ -1098,6 +1120,16 @@ class FleetRouter:
             int(h.get("queue_depth", 0)) for h in sweep)
         stats["replicas_ready"] = sum(
             bool(h.get("ready")) for h in sweep)
+        # prefix-sharing / speculative-decoding merged view: summed
+        # page gauges, fleet-mean accept rate (paged replicas only)
+        stats["shared_blocks"] = sum(
+            int(h.get("shared_blocks", 0)) for h in sweep)
+        stats["cow_forks"] = sum(
+            int(h.get("cow_forks", 0)) for h in sweep)
+        rates = [float(h["spec_accept_rate"]) for h in sweep
+                 if "spec_accept_rate" in h]
+        if rates:
+            stats["spec_accept_rate"] = sum(rates) / len(rates)
         stats.update(self.latency_summary())
         writer(writer.advance_step(),
                {f"fleet/{k}": float(v) for k, v in stats.items()})
@@ -1165,6 +1197,9 @@ class FleetRouter:
                         and rep.breaker.routable and not rep.draining:
                     ready += 1
             entries.append(entry)
+        sweep = [e.get("health") or {} for e in entries]
+        rates = [float(h["spec_accept_rate"]) for h in sweep
+                 if "spec_accept_rate" in h]
         out = {
             "status": "serving" if (self._running
                                     and not self._stopping)
@@ -1172,6 +1207,13 @@ class FleetRouter:
             "ready": ready > 0 and self._running and not self._stopping,
             "replicas_ready": ready,
             "replicas": entries,
+            # fleet-merged prefix-sharing / drafting gauges
+            "shared_blocks": sum(
+                int(h.get("shared_blocks", 0)) for h in sweep),
+            "cow_forks": sum(
+                int(h.get("cow_forks", 0)) for h in sweep),
+            "spec_accept_rate": (sum(rates) / len(rates)
+                                 if rates else 0.0),
             "supervisor_error": (None if self.supervisor_error is None
                                  else repr(self.supervisor_error)),
         }

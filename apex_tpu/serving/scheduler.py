@@ -211,8 +211,12 @@ class Scheduler:
                 if not self._queue:
                     break
                 head = self._queue[0]
+                # shared-aware token gate: the engine discounts
+                # trie-resident prefix pages, so a hot-prompt request
+                # admits into capacity sharing reclaimed
                 if not self.engine.can_admit(head.prompt.shape[0],
-                                             head.max_new_tokens):
+                                             head.max_new_tokens,
+                                             prompt=head.prompt):
                     counters.inc("serving.admit_blocked")
                     break
                 req = self._queue.popleft()
@@ -292,10 +296,10 @@ class Scheduler:
             return []
         out = self.engine.step()
         if isinstance(out, StepOutput):
-            tokens, finished, emitted, preempted = out
+            tokens, finished, _emitted, preempted, counts = out
         else:
             tokens, finished = out
-            emitted, preempted = None, ()
+            counts, preempted = None, ()
         for slot in preempted:
             req = self._slots[slot]
             if req is None:
@@ -311,15 +315,20 @@ class Scheduler:
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
-            if emitted is not None and not bool(emitted[slot]):
+            # a drafted (speculative) step can emit SEVERAL tokens for
+            # one slot — route each in order, finishing on the last
+            n_emit = 1 if counts is None else int(counts[slot])
+            if n_emit == 0:
                 continue
-            tok = int(tokens[slot])
-            fin = bool(finished[slot])
-            req.tokens.append(tok)
-            events.append(StepEvent(req, tok, fin))
-            if fin:
-                self.engine.release(slot)
-                self._slots[slot] = None
+            row = tokens[slot]
+            for j in range(n_emit):
+                tok = int(row[j]) if np.ndim(row) else int(row)
+                fin = bool(finished[slot]) and j == n_emit - 1
+                req.tokens.append(tok)
+                events.append(StepEvent(req, tok, fin))
+                if fin:
+                    self.engine.release(slot)
+                    self._slots[slot] = None
         return events
 
     def drain(self) -> List[StepEvent]:

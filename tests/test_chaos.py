@@ -354,6 +354,99 @@ class TestPagedServingChaosSoak:
             "decode_step": 1, "prefill_step": 1, "admit": 1,
             "release": 1}
 
+    def test_soak_sharing_and_spec_no_leaks_token_identical(self):
+        """ISSUE-7 chaos satellite: the paged soak with prefix sharing
+        AND speculative decoding on.  Transient step/admit faults,
+        deadline expiries, and pool-pressure preempt-requeues all ride
+        refcounted shared pages and drafted steps — at the end not one
+        page is leaked (``blocks_in_use == 0`` exactly: a refcount
+        miscount would strand or double-free pages), every surviving
+        greedy chain is token-identical to ``generate()``, and the
+        trace budget is exactly the warmed 5 × 1."""
+        model, params = self._tiny()
+        server = InferenceServer(model, params, max_slots=3,
+                                 kv_cache="paged", block_size=8,
+                                 pool_tokens=160, prefill_chunk=4,
+                                 admit_headroom=0, share_prefixes=True,
+                                 spec_tokens=3)
+        plan = FaultPlan([
+            FaultSpec(site="serving.step", kind="transient", every=6,
+                      times=3),
+            FaultSpec(site="serving.admit", kind="transient", step=4,
+                      times=1),
+        ])
+        rng = np.random.default_rng(71)
+        pref = rng.integers(0, model.cfg.vocab_size,
+                            size=(16,)).astype(np.int32)
+        cases = []                   # (prompt, n, temperature, seed)
+        for i in range(12):
+            if i % 2 == 0:           # hot shared prompt, lookup-friendly
+                prompt = np.concatenate([pref, rng.integers(
+                    0, model.cfg.vocab_size,
+                    size=(1 + i // 2,)).astype(np.int32)])
+            else:                    # cold random traffic
+                prompt = rng.integers(0, model.cfg.vocab_size,
+                                      size=(3 + i,)).astype(np.int32)
+            cases.append((prompt, 4 + i % 8, 0.0 if i % 3 else 0.0, i))
+        with active(plan):
+            with server:
+                before = tracecheck.trace_event_count()
+                handles = [
+                    server.submit(p, max_new_tokens=n, temperature=t,
+                                  seed=s)
+                    for p, n, t, s in cases]
+                doomed = [server.submit(
+                    np.concatenate([pref, np.zeros(2, np.int32)]),
+                    max_new_tokens=5, deadline=1e-4)
+                    for _ in range(2)]
+                completed, failed, hung = 0, 0, 0
+                survivors = []
+                for (p, n, _t, _s), h in zip(cases, handles):
+                    try:
+                        toks = h.result(timeout=300)
+                        completed += 1
+                        survivors.append((p, n, toks))
+                    except RequestFailed:
+                        failed += 1
+                    except TimeoutError:
+                        hung += 1
+                for h in doomed:
+                    try:
+                        h.result(timeout=300)
+                        completed += 1
+                    except RequestFailed:
+                        failed += 1
+                    except TimeoutError:
+                        hung += 1
+                health = server.health()
+                after = tracecheck.trace_event_count()
+
+        assert hung == 0
+        assert completed + failed == len(cases) + len(doomed)
+        assert completed >= len(cases) - 2
+        assert health["status"] == "serving", health
+        assert server.error is None
+        # the tentpole invariant under SHARING: every page came home —
+        # refcounts balanced across faults, deadlines, preempts,
+        # CoW forks and normal completion
+        assert health["blocks_in_use"] == 0
+        assert server.engine.blocks_in_use == 0
+        assert server.engine.shared_blocks == 0
+        # greedy chains that completed are token-identical (across
+        # shared prefixes, drafted steps and any preempt-requeue)
+        for p, n, toks in survivors:
+            ref = np.asarray(generate(
+                model, params, jnp.asarray(p[None]),
+                max_new_tokens=n))[0, len(p):]
+            np.testing.assert_array_equal(np.asarray(toks), ref)
+        # drafting actually happened, and recovery replayed compiled
+        # programs at the exact warmed budget — 5 executables, 1 each
+        assert server.engine.spec_proposed > 0
+        assert after == before, "sharing+spec chaos soak retraced"
+        assert server.engine.trace_counts == {
+            "decode_step": 1, "prefill_step": 1, "spec_step": 1,
+            "admit": 1, "release": 1}
+
 
 class TestFleetChaosSoak:
     """ISSUE-6 acceptance: a 3-replica FleetRouter under mixed

@@ -54,7 +54,7 @@ class FakeServer:
     the real tap plumbing."""
 
     def __init__(self, *, blocks=(0, 16), queue_depth=0, occupancy=0.0,
-                 reject=None):
+                 reject=None, prefix_hit=0):
         self.calls = []
         self.live = {}                  # key -> (prompt, kwargs, tap)
         self._keys = itertools.count()
@@ -62,10 +62,15 @@ class FakeServer:
         self.queue_depth = queue_depth
         self.occupancy = occupancy
         self.reject = reject            # exception class raised on submit
+        self.prefix_hit = prefix_hit    # scripted trie hit (affinity)
         self.running = False
         self.draining = False
         self.metrics = None
         self.metrics_interval = 32
+
+    def prefix_hit_blocks(self, prompt):
+        del prompt
+        return self.prefix_hit
 
     # ------------------------------------------------ server surface
     def start(self, *, warmup=True):
@@ -177,6 +182,27 @@ class TestSelectionMath:
         assert select_replica(healths) == 2
         assert select_replica([None, {"ready": False}]) == -1
         assert select_replica([]) == -1
+
+    def test_prefix_affinity_breaks_load_ties(self):
+        """ISSUE-7 satellite: equal load, the replica whose trie
+        already holds the request's prefix wins — before queue depth,
+        after load (affinity concentrates a hot prompt, never
+        overrides least-loaded)."""
+        healths = [
+            {"ready": True, "blocks_in_use": 4, "blocks_total": 16,
+             "queue_depth": 0},
+            {"ready": True, "blocks_in_use": 4, "blocks_total": 16,
+             "queue_depth": 2},
+        ]
+        # tie on load: affinity outranks the lower queue depth
+        assert select_replica(healths, affinity=[0, 3]) == 1
+        # affinity never overrides a load difference
+        healths[1]["blocks_in_use"] = 8
+        assert select_replica(healths, affinity=[0, 3]) == 0
+        # no affinity info: pre-ISSUE-7 ordering unchanged
+        healths[1]["blocks_in_use"] = 4
+        assert select_replica(healths) == 0
+        assert select_replica(healths, affinity=None) == 0
 
 
 class TestRouteBackoff:
@@ -323,6 +349,21 @@ class TestRouting:
         router.submit([4], max_new_tokens=1)
         assert a.submits() == []
         assert b.submits() == [("submit", [4], 1)]
+        router.shutdown(wait=False)
+
+    def test_prefix_affinity_routes_to_the_trie_holder(self):
+        """Equal-load replicas: the one whose trie holds the request's
+        prefix (``prefix_hit_blocks``) gets the request — and a loaded
+        trie holder still loses to a less-loaded cold replica."""
+        a = FakeServer(blocks=(4, 16))
+        b = FakeServer(blocks=(4, 16), prefix_hit=2)
+        router = _router([a, b])
+        router.submit([7, 7, 7], max_new_tokens=1)
+        assert a.submits() == []
+        assert b.submits() == [("submit", [7, 7, 7], 1)]
+        b.blocks_in_use = 12                 # now clearly hotter
+        router.submit([7, 7, 7], max_new_tokens=1)
+        assert a.submits() == [("submit", [7, 7, 7], 1)]
         router.shutdown(wait=False)
 
     def test_exhausted_retries_surface_request_failed(self):

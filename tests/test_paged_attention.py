@@ -126,6 +126,60 @@ class TestLogicalContentOnly:
                                       np.asarray(moved))
 
 
+class TestSpeculativeVerifyChunk:
+    """The multi-query verify path (ISSUE 7): one ``s = 1 + k``
+    application scores a draft run with per-position context identical
+    to k+1 sequential one-token steps, and a REJECTED tail's stale
+    K/V — live pages past a rolled-back cursor — is unreachable."""
+
+    @pytest.mark.parametrize("impl", ["xla", "pallas_interpret"])
+    def test_verify_chunk_matches_sequential_decode(self, impl):
+        rng = np.random.default_rng(7)
+        b, h, hk, d, NB, BS, MB, k = 2, 4, 2, 16, 24, 8, 6, 3
+        lengths = [9, 17]
+        kp, vp, tables = _pool_setup(
+            rng, b=b, hk=hk, d=d, NB=NB, BS=BS, MB=MB,
+            lengths=lengths, s=1 + k, dtype=jnp.float32)
+        q = jnp.asarray(rng.normal(size=(b, 1 + k, h, d)), jnp.float32)
+        lens = jnp.asarray(lengths, jnp.int32)
+        chunk = paged_attention(q, kp, vp, jnp.asarray(tables), lens,
+                                implementation=impl)
+        # sequential: query j alone at its own position (the pool
+        # already holds every draft's K/V — write-then-attend)
+        for j in range(1 + k):
+            one = paged_attention(
+                q[:, j:j + 1], kp, vp, jnp.asarray(tables), lens + j,
+                implementation=impl)
+            np.testing.assert_allclose(
+                np.asarray(chunk[:, j]), np.asarray(one[:, 0]),
+                atol=2e-6, rtol=2e-6)
+
+    def test_rejected_tail_garbage_is_unreachable(self):
+        """Rollback contract: after the engine rejects a draft tail,
+        its K/V stays in LIVE pages past the new cursor — the next
+        step's queries must not see it.  Poison those positions; the
+        masked output must not change a bit."""
+        rng = np.random.default_rng(8)
+        b, h, hk, d, NB, BS, MB = 1, 4, 2, 16, 16, 8, 4
+        L = 10                     # cursor after rolling 3 drafts back
+        kp, vp, tables = _pool_setup(
+            rng, b=b, hk=hk, d=d, NB=NB, BS=BS, MB=MB,
+            lengths=[L], s=1, dtype=jnp.float32)
+        q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+        lens = jnp.asarray([L], jnp.int32)
+        base = paged_attention(q, kp, vp, jnp.asarray(tables), lens,
+                               implementation="xla")
+        kp2, vp2 = np.asarray(kp).copy(), np.asarray(vp).copy()
+        blk, off = tables[0, (L + 1) // BS], (L + 1) % BS
+        kp2[:, blk, off:] = 1e3    # stale draft K/V in the live page
+        vp2[:, blk, off:] = 1e3
+        poisoned = paged_attention(
+            q, jnp.asarray(kp2), jnp.asarray(vp2),
+            jnp.asarray(tables), lens, implementation="xla")
+        np.testing.assert_array_equal(np.asarray(base),
+                                      np.asarray(poisoned))
+
+
 class TestDenseParityAnchor:
     def test_reference_matches_dense_cache_attention(self):
         """Paged reference == the dense engine's cache attention on

@@ -19,7 +19,15 @@ Correctness contracts under test:
 - eviction releases pages (deadline/fault paths reuse the same
   release), sampled chains are a function of the request's own seed,
   and the server surfaces TTFT / step-latency percentiles and the
-  blocks-occupancy gauge.
+  blocks-occupancy gauge;
+- copy-on-write prefix sharing (ISSUE 7): refcounted page sharing of
+  trie-matched prompt prefixes, CoW fork at whole-prompt hits, exact
+  ``blocks_in_use`` accounting under sharing, shared-aware admission,
+  and greedy chains token-identical with sharing on;
+- speculative decoding (ISSUE 7): the prompt-lookup drafter, the
+  one-application K-token verify, acceptance-invariant greedy AND
+  sampled chains, the accept-rate gauge, and the 5-executable /
+  zero-retrace budget with drafting on.
 """
 
 import numpy as np
@@ -34,8 +42,11 @@ from apex_tpu.serving import (
     BlockExhausted,
     InferenceServer,
     PagedEngine,
+    PrefixTrie,
     Request,
     Scheduler,
+    chain_digests,
+    prompt_lookup_draft,
 )
 from apex_tpu.serving import cache as slot_cache
 from apex_tpu.utils import MetricsWriter, tracecheck
@@ -461,3 +472,389 @@ class TestTrafficModel:
         assert 3.5 <= ratio <= 4.5
         assert small["paged_kv_read_bytes_per_step"] \
             < small["dense_kv_read_bytes_per_step"]
+
+
+class TestRefcountedAllocator:
+    def test_incref_defers_free_and_counts_sharing(self):
+        alloc = BlockAllocator(9, 4)
+        a = alloc.alloc(2)
+        assert alloc.refcount(a[0]) == 1
+        assert alloc.incref(a[0]) == 2
+        assert alloc.shared_blocks == 1
+        assert alloc.blocks_saved == 1
+        # first free decrements; the page stays allocated
+        assert alloc.free([a[0]]) == []
+        assert alloc.blocks_in_use == 2
+        assert alloc.shared_blocks == 0
+        # last reference frees for real, and is reported
+        assert alloc.free([a[0]]) == [a[0]]
+        assert alloc.blocks_in_use == 1
+        assert alloc.free([a[1]]) == [a[1]]
+        assert alloc.blocks_in_use == 0
+
+    def test_double_free_still_raises_under_refcounts(self):
+        alloc = BlockAllocator(5, 2)
+        got = alloc.alloc(1)
+        alloc.free(got)
+        with pytest.raises(ValueError, match="double free"):
+            alloc.free(got)
+
+    def test_incref_of_free_page_raises(self):
+        alloc = BlockAllocator(5, 2)
+        got = alloc.alloc(1)
+        alloc.free(got)
+        with pytest.raises(ValueError, match="not allocated"):
+            alloc.incref(got[0])
+
+
+class TestPrefixTrie:
+    def test_chain_digests_identify_whole_prefixes(self):
+        a = np.arange(20, dtype=np.int32)
+        b = a.copy()
+        b[10] += 1                       # diverge inside block 1
+        da, db = chain_digests(a, 8), chain_digests(b, 8)
+        assert len(da) == len(db) == 2   # only FULL blocks hash
+        assert da[0] == db[0]
+        assert da[1] != db[1]
+        # chaining: same block tokens after a divergent block differ
+        c = np.concatenate([b[:8], a[8:]])
+        dc = chain_digests(c, 8)
+        assert dc[0] == da[0] and dc[1] == da[1]
+
+    def test_register_match_forget(self):
+        trie = PrefixTrie()
+        d = chain_digests(np.arange(24, dtype=np.int32), 8)
+        assert trie.register(d[0], 5)
+        assert trie.register(d[1], 9)
+        assert not trie.register(d[0], 7)    # first writer wins
+        assert trie.match(d) == [5, 9]       # longest-prefix hit
+        trie.forget(9)
+        assert trie.match(d) == [5]
+        assert not trie.holds_block(9) and trie.holds_block(5)
+        trie.forget(9)                       # idempotent no-op
+        assert len(trie) == 1
+
+
+class TestPromptLookupDraft:
+    def test_ngram_continuation_found(self):
+        ctx = np.array([1, 2, 3, 4, 1, 2, 3], np.int32)
+        np.testing.assert_array_equal(
+            prompt_lookup_draft(ctx, 3), [4, 1, 2])
+
+    def test_most_recent_match_and_fallback(self):
+        # trailing [5] occurs twice: the LATER continuation wins
+        ctx = np.array([5, 7, 0, 5, 9, 5], np.int32)
+        np.testing.assert_array_equal(
+            prompt_lookup_draft(ctx, 2, max_ngram=3), [9, 5])
+        # no match anywhere -> empty (row decodes undrafted)
+        assert prompt_lookup_draft(
+            np.array([1, 2, 3], np.int32), 4).size == 0
+
+    def test_k_caps_the_proposal(self):
+        ctx = np.array([1, 2, 1, 2], np.int32)
+        assert prompt_lookup_draft(ctx, 1).size == 1
+
+
+class TestPrefixSharing:
+    def test_shared_prefix_parity_gauges_and_refcounts(self, gpt):
+        """Two tenants share a two-page prompt prefix: the second
+        admission maps the first's pages (blocks_in_use grows by the
+        PRIVATE tail only), both greedy chains match generate(), and
+        the pool drains to zero."""
+        model, params = gpt
+        rng = np.random.default_rng(31)
+        pref = rng.integers(0, model.cfg.vocab_size,
+                            size=(16,)).astype(np.int32)
+        pa = np.concatenate([pref, rng.integers(
+            0, model.cfg.vocab_size, size=(3,)).astype(np.int32)])
+        pb = np.concatenate([pref, rng.integers(
+            0, model.cfg.vocab_size, size=(5,)).astype(np.int32)])
+        engine = PagedEngine(model, params, max_slots=2, block_size=8,
+                             prefill_chunk=4, share_prefixes=True)
+        sched = Scheduler(engine)
+        ra = sched.submit(Request(prompt=pa, max_new_tokens=6))
+        for _ in range(6):               # A past prefill, still live
+            sched.run_step()
+        assert engine.trie_blocks == 2   # A's full prompt blocks
+        use_before = engine.blocks_in_use
+        rb = sched.submit(Request(prompt=pb, max_new_tokens=6))
+        sched.run_step()
+        # B's two prefix pages are MAPPED, not allocated
+        assert engine.shared_blocks == 2
+        assert engine.blocks_saved == 2
+        assert engine.blocks_in_use <= use_before + 1
+        assert engine.cow_forks == 0     # divergent tail: no fork
+        sched.drain()
+        for p, r in ((pa, ra), (pb, rb)):
+            ref = np.asarray(generate(
+                model, params, jnp.asarray(p[None]),
+                max_new_tokens=6))[0, len(p):]
+            np.testing.assert_array_equal(np.asarray(r.tokens), ref)
+        assert engine.blocks_in_use == 0
+        assert engine.shared_blocks == 0
+
+    def test_whole_prompt_hit_cow_forks_and_stays_identical(self, gpt):
+        """Page-boundary prompt fully resident in the trie: the last
+        matched block is CoW-forked (re-derived private) so the
+        re-fed final prompt token never writes a shared page — greedy
+        output identical for both tenants."""
+        model, params = gpt
+        rng = np.random.default_rng(37)
+        prompt = rng.integers(0, model.cfg.vocab_size,
+                              size=(16,)).astype(np.int32)  # 2 pages
+        engine = PagedEngine(model, params, max_slots=2, block_size=8,
+                             prefill_chunk=4, share_prefixes=True)
+        sched = Scheduler(engine)
+        ra = sched.submit(Request(prompt=prompt, max_new_tokens=8))
+        for _ in range(5):
+            sched.run_step()
+        rb = sched.submit(Request(prompt=prompt.copy(),
+                                  max_new_tokens=8))
+        sched.run_step()
+        assert engine.cow_forks == 1
+        assert engine.shared_blocks == 1     # block 0 shared, 1 forked
+        sched.drain()
+        ref = np.asarray(generate(
+            model, params, jnp.asarray(prompt[None]),
+            max_new_tokens=8))[0, 16:]
+        np.testing.assert_array_equal(np.asarray(ra.tokens), ref)
+        np.testing.assert_array_equal(np.asarray(rb.tokens), ref)
+        assert engine.blocks_in_use == 0
+
+    def test_can_admit_discounts_trie_resident_prefix(self, gpt):
+        """Shared-aware token gate: a request whose prefix is resident
+        admits into capacity that would block an unshared twin — the
+        reclaimed pool converts into admitted occupancy."""
+        model, params = gpt
+        rng = np.random.default_rng(41)
+        pref = rng.integers(0, model.cfg.vocab_size,
+                            size=(16,)).astype(np.int32)
+        engine = PagedEngine(model, params, max_slots=3, block_size=8,
+                             pool_tokens=48, prefill_chunk=4,
+                             admit_headroom=8, share_prefixes=True)
+        sched = Scheduler(engine)
+        sched.submit(Request(prompt=np.concatenate(
+            [pref, rng.integers(0, model.cfg.vocab_size,
+                                size=(2,)).astype(np.int32)]),
+            max_new_tokens=4))
+        for _ in range(6):
+            sched.run_step()
+        # 3 of 6 pages held; a fresh 18+8-token request needs 4 pages
+        # -> blocked unshared, admitted when 2 pages are trie hits
+        fresh = rng.integers(0, model.cfg.vocab_size,
+                             size=(18,)).astype(np.int32)
+        shared = np.concatenate([pref, fresh[:2]])
+        assert not engine.can_admit(18, 8, prompt=fresh)
+        assert engine.can_admit(18, 8, prompt=shared)
+        assert engine.prefix_hit_blocks(shared) == 2
+        assert engine.prefix_hit_blocks(fresh) == 0
+
+    def test_preempt_requeue_reshares_and_drains(self, gpt):
+        """Preemption under sharing: refcounts decrement (never
+        double-free), the requeued continuation re-matches surviving
+        trie pages, greedy chains stay identical, pool drains to 0."""
+        model, params = gpt
+        rng = np.random.default_rng(43)
+        pref = rng.integers(0, model.cfg.vocab_size,
+                            size=(16,)).astype(np.int32)
+        p1 = np.concatenate([pref, rng.integers(
+            0, model.cfg.vocab_size, size=(4,)).astype(np.int32)])
+        p2 = np.concatenate([pref, rng.integers(
+            0, model.cfg.vocab_size, size=(6,)).astype(np.int32)])
+        engine = PagedEngine(model, params, max_slots=2, block_size=8,
+                             pool_tokens=64, prefill_chunk=4,
+                             admit_headroom=0, share_prefixes=True)
+        sched = Scheduler(engine)
+        r1 = sched.submit(Request(prompt=p1, max_new_tokens=28))
+        r2 = sched.submit(Request(prompt=p2, max_new_tokens=26))
+        sched.drain()
+        assert sched.preempts >= 1
+        for p, n, r in ((p1, 28, r1), (p2, 26, r2)):
+            ref = np.asarray(generate(
+                model, params, jnp.asarray(p[None]),
+                max_new_tokens=n))[0, len(p):]
+            np.testing.assert_array_equal(np.asarray(r.tokens), ref)
+        assert engine.blocks_in_use == 0
+
+
+class TestSpeculativeDecoding:
+    def test_greedy_parity_across_boundaries_with_spec_on(self, gpt):
+        """Draft/verify on: greedy chains must reproduce generate()
+        exactly at page-boundary (8/16), chunk-boundary (4) and
+        straddling prompt lengths — lookup-friendly (repetitive) and
+        lookup-hostile (random) prompts alike."""
+        model, params = gpt
+        rng = np.random.default_rng(47)
+        prompts = [np.tile(rng.integers(
+            0, model.cfg.vocab_size, size=(4,)).astype(np.int32), 4)]
+        for L in (4, 7, 8, 9, 16, 17):
+            prompts.append(rng.integers(
+                0, model.cfg.vocab_size, size=(L,)).astype(np.int32))
+        engine = PagedEngine(model, params, max_slots=2, block_size=8,
+                             prefill_chunk=4, spec_tokens=3)
+        sched = Scheduler(engine)
+        reqs = [sched.submit(Request(prompt=p, max_new_tokens=6))
+                for p in prompts]
+        sched.drain()
+        for p, r in zip(prompts, reqs):
+            ref = np.asarray(generate(
+                model, params, jnp.asarray(p[None]),
+                max_new_tokens=6))[0, len(p):]
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens), ref,
+                err_msg=f"prompt_len={len(p)}")
+        assert engine.spec_proposed > 0      # drafting actually ran
+        assert engine.blocks_in_use == 0
+
+    def test_sampled_chains_are_acceptance_invariant(self, gpt):
+        """temperature>0: the k-th produced token always consumes the
+        k-th rng split, so the SAME seeded chain comes out with
+        drafting off, with an ORACLE drafter (every draft accepted —
+        multi-token emissions), and with a hostile drafter (every
+        draft rejected — pure rollback)."""
+        model, params = gpt
+        rng = np.random.default_rng(53)
+        prompt = np.tile(rng.integers(
+            0, model.cfg.vocab_size, size=(5,)).astype(np.int32), 3)
+
+        def run(k, drafter=None):
+            engine = PagedEngine(model, params, max_slots=1,
+                                 block_size=8, prefill_chunk=4,
+                                 spec_tokens=k)
+            if drafter is not None:
+                engine._drafter = drafter
+            sched = Scheduler(engine)
+            req = sched.submit(Request(
+                prompt=prompt, max_new_tokens=7, temperature=0.9,
+                top_k=20, seed=123))
+            sched.drain()
+            assert engine.blocks_in_use == 0
+            return (list(req.tokens), engine.spec_proposed,
+                    engine.spec_accepted)
+
+        base, _, _ = run(0)
+
+        def oracle(context, k, ngram):
+            # proposes the chain the model is about to sample
+            pos = context.size - prompt.size
+            return np.asarray(base[pos:pos + k], np.int32)
+
+        def hostile(context, k, ngram):
+            tok = (int(context[-1]) + 1) % model.cfg.vocab_size
+            return np.full((k,), tok, np.int32)
+
+        toks, proposed, accepted = run(3, oracle)
+        assert toks == base
+        assert proposed > 0 and accepted > 0   # multi-emit steps ran
+        toks, proposed, accepted = run(3, hostile)
+        assert toks == base
+        assert proposed > 0                    # rollbacks ran
+
+    def test_eos_inside_accepted_run_stops_exactly(self, gpt):
+        """An accepted draft that samples eos mid-run must truncate
+        the emission at eos — byte-for-byte the sequential stop."""
+        model, params = gpt
+        rng = np.random.default_rng(59)
+        prompt = np.tile(rng.integers(
+            0, model.cfg.vocab_size, size=(3,)).astype(np.int32), 4)
+        ref = np.asarray(generate(
+            model, params, jnp.asarray(prompt[None]),
+            max_new_tokens=8))[0, len(prompt):]
+        eos = int(ref[3])
+        engine = PagedEngine(model, params, max_slots=1, block_size=8,
+                             prefill_chunk=4, spec_tokens=4)
+        sched = Scheduler(engine)
+        req = sched.submit(Request(prompt=prompt, max_new_tokens=8,
+                                   eos_id=eos))
+        sched.drain()
+        got = np.asarray(req.tokens)
+        first = int(np.argmax(ref == eos))
+        np.testing.assert_array_equal(got, ref[:first + 1])
+        assert got[-1] == eos
+        assert engine.blocks_in_use == 0
+
+    def test_soak_sharing_and_spec_zero_retraces_at_budget(self, gpt):
+        """The ISSUE-7 acceptance soak: mixed shared/unshared AND
+        drafted/undrafted traffic with heterogeneous sampling params
+        — zero retraces after warmup at the documented budget of FIVE
+        executables (decode/prefill/spec/admit/release = 1 each), and
+        the accept-rate gauge moves."""
+        model, params = gpt
+        engine = PagedEngine(model, params, max_slots=3, block_size=8,
+                             prefill_chunk=4, share_prefixes=True,
+                             spec_tokens=3)
+        sched = Scheduler(engine)
+        engine.warmup()
+        budget = {"decode_step": 1, "prefill_step": 1, "spec_step": 1,
+                  "admit": 1, "release": 1}
+        assert engine.trace_counts == budget
+
+        rng = np.random.default_rng(61)
+        pref = rng.integers(0, model.cfg.vocab_size,
+                            size=(16,)).astype(np.int32)
+        before = tracecheck.trace_event_count()
+        reqs = []
+        for i in range(10):
+            if i % 2 == 0:      # hot-prompt traffic (shared, lookupy)
+                prompt = np.concatenate([pref, rng.integers(
+                    0, model.cfg.vocab_size,
+                    size=(1 + i // 2,)).astype(np.int32)])
+            else:               # cold random traffic
+                prompt = rng.integers(
+                    0, model.cfg.vocab_size,
+                    size=(3 + i,)).astype(np.int32)
+            t, k, p = [(0.0, None, None), (0.8, 20, None),
+                       (1.2, 5, 0.9)][i % 3]
+            reqs.append(sched.submit(Request(
+                prompt=prompt, max_new_tokens=3 + i % 4,
+                temperature=t, top_k=k, top_p=p, seed=i)))
+        sched.drain()
+        assert tracecheck.trace_event_count() == before, (
+            "sharing+spec soak retraced after warmup")
+        assert engine.trace_counts == budget
+        for r in reqs:
+            assert len(r.tokens) == r._budget0
+        assert engine.spec_proposed > 0
+        assert 0.0 <= engine.spec_accept_rate <= 1.0
+        assert engine.blocks_in_use == 0
+        assert engine.shared_blocks == 0
+
+    def test_server_knobs_and_gauges(self, gpt):
+        """InferenceServer plumbs the knobs through and surfaces the
+        new gauges in health() and metrics emissions; dense servers
+        reject them loudly."""
+        model, params = gpt
+        with pytest.raises(ValueError, match="paged"):
+            InferenceServer(model, params, spec_tokens=2)
+        with pytest.raises(ValueError, match="paged"):
+            InferenceServer(model, params, share_prefixes=True)
+        rng = np.random.default_rng(67)
+        pref = rng.integers(0, model.cfg.vocab_size,
+                            size=(16,)).astype(np.int32)
+        rows = []
+        writer = MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+        server = InferenceServer(
+            model, params, max_slots=2, kv_cache="paged", block_size=8,
+            prefill_chunk=4, share_prefixes=True, spec_tokens=3,
+            metrics=writer, metrics_interval=2)
+        prompt = np.tile(pref[:4], 4).astype(np.int32)
+        ref = np.asarray(generate(
+            model, params, jnp.asarray(prompt[None]),
+            max_new_tokens=6))[0, len(prompt):]
+        with server:
+            h1 = server.submit(prompt, max_new_tokens=6)
+            h2 = server.submit(np.concatenate([pref, pref[:1]]),
+                               max_new_tokens=4)
+            got = h1.result(timeout=300)
+            h2.result(timeout=300)
+            health = server.health()
+            assert server.prefix_hit_blocks(pref) >= 0
+        np.testing.assert_array_equal(np.asarray(got), ref)
+        assert {"shared_blocks", "cow_forks",
+                "spec_accept_rate"} <= set(health)
+        assert health["blocks_in_use"] == 0
+        merged = {}
+        for _, m in rows:
+            merged.update(m)
+        assert {"shared_blocks", "cow_forks",
+                "spec_accept_rate"} <= set(merged)

@@ -27,6 +27,13 @@ SUBSET = [
     # tables + the DMA-skip clamp are exactly what interpret mode
     # cannot prove — the gather path must run on the real chip
     "tests/test_paged_attention.py",
+    # prefix-shared CoW pages + speculative decoding (ISSUE 7): the
+    # refcount/trie accounting and the drafted-step verify rollback
+    # must hold against REAL pool pages — on chip a leaked or
+    # double-freed page corrupts a co-tenant's KV instead of a numpy
+    # shadow, and the spec_step executable must Mosaic-compile at its
+    # 1+K width
+    "tests/test_paged_serving.py",
     "tests/test_layer_norm.py",
     "tests/test_ops.py",
     "tests/test_optim.py",
