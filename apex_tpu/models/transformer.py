@@ -29,7 +29,11 @@ import flax.linen as nn
 from apex_tpu.core.mesh import TENSOR_AXIS
 from apex_tpu.ops.attention import fused_attention
 from apex_tpu.ops.layer_norm import fused_layer_norm, fused_rms_norm
-from apex_tpu.ops.paged_attention import paged_attention
+from apex_tpu.ops.paged_attention import (
+    kv_quant_spec,
+    paged_attention,
+    quantize_kv,
+)
 from apex_tpu.ops.mlp import resolve_activation
 from apex_tpu.ops.rope import fused_rope, rope_cos_sin
 from apex_tpu.transformer.layers import (
@@ -124,6 +128,17 @@ class TransformerConfig:
     kv_cache: str = "dense"
     kv_block_size: int = 16                 # tokens per page (paged)
     kv_pool_blocks: int = 0                 # pool pages incl. null page
+    # paged-pool STORAGE dtype: None stores K/V in the compute dtype;
+    # "int8" / "fp8" (float8_e4m3fn, where the jax build has it) store
+    # 1-byte codes with one fp32 amax scale per (kv_head, page) riding
+    # the cache beside the block table — ~2× (bf16) to ~4× (fp32) the
+    # token capacity at equal HBM, dequantized in-register inside
+    # ops.paged_attention.  Scales are maintained by the write path
+    # (reset at a page's first write, monotone running amax on
+    # append), so shared/CoW/preempted pages carry their scale with
+    # them and the engine's accounting never changes.  Paged-only: the
+    # dense slab and the training path always store the compute dtype.
+    kv_dtype: Optional[str] = None
     # flash-attention kernel tile sizes; None = the kernel's seq-aware
     # default (512 at short seq — isolated-op sweeps can mislead: in
     # the full rematted model 512/512 measures fastest at s=512 — and
@@ -200,6 +215,16 @@ class TransformerConfig:
                 raise ValueError(
                     "kv_pool_blocks must be >= 2 (block 0 is the "
                     f"reserved null page), got {self.kv_pool_blocks}")
+        if self.kv_dtype is not None:
+            if self.kv_cache != "paged":
+                raise ValueError(
+                    "kv_dtype requires kv_cache='paged' — quantized "
+                    "KV pages live in the paged pool (per-page scales "
+                    "beside the block table); the dense slab stores "
+                    "K/V in the compute dtype")
+            # unknown names / fp8 on a build without float8_e4m3fn
+            # raise here, at config time
+            kv_quant_spec(self.kv_dtype)
         if self.num_moe_experts is not None:
             if self.num_moe_experts < 2:
                 raise ValueError(
@@ -419,10 +444,30 @@ class ParallelAttention(nn.Module):
         S = cfg.max_seq_len
         NB, BS = cfg.kv_pool_blocks, cfg.kv_block_size
         MB = -(-S // BS)
+        store_dt, qmax = kv_quant_spec(cfg.kv_dtype)
         pk = self.variable("cache", "paged_key", jnp.zeros,
-                           (hk, NB, BS, d), k.dtype)
+                           (hk, NB, BS, d),
+                           k.dtype if store_dt is None else store_dt)
         pv = self.variable("cache", "paged_value", jnp.zeros,
-                           (hk, NB, BS, d), v.dtype)
+                           (hk, NB, BS, d),
+                           v.dtype if store_dt is None else store_dt)
+        if store_dt is not None:
+            # per-(kv_head, page) fp32 amax scales, living beside the
+            # block table; page 0's entry is garbage like the null
+            # page itself (the position mask keeps both unreachable)
+            ksc = self.variable("cache", "key_scales", jnp.zeros,
+                                (hk, NB), jnp.float32)
+            vsc = self.variable("cache", "value_scales", jnp.zeros,
+                                (hk, NB), jnp.float32)
+            # per-row REAL lane count for this chunk (engine-owned,
+            # like tables/cursors): the unquantized path can let pad
+            # lanes write K/V that the next real token overwrites, but
+            # the scale scatter-max is MONOTONE — a pad lane's amax
+            # would pollute the page scale forever — so pad lanes must
+            # be routed to the null page.  Defaults to "every lane
+            # real" (max_seq_len) for non-engine callers.
+            cl = self.variable("cache", "chunk_lens", jnp.full,
+                               (b,), S, jnp.int32)
         bt = self.variable("cache", "block_tables", jnp.zeros,
                            (b, MB), jnp.int32)
         cur = self.variable("cache", "cursors", jnp.zeros,
@@ -446,10 +491,70 @@ class ParallelAttention(nn.Module):
         # when a near-full tenant rides a wide mixed step
         phys = jnp.where(positions < S, phys, 0)
         off = positions % BS
-        pk.value = pk.value.at[:, phys, off].set(k.transpose(2, 0, 1, 3))
-        pv.value = pv.value.at[:, phys, off].set(v.transpose(2, 0, 1, 3))
+        kT = k.transpose(2, 0, 1, 3)             # (hk, b, s, d)
+        vT = v.transpose(2, 0, 1, 3)
+        if store_dt is None:
+            pk.value = pk.value.at[:, phys, off].set(kT)
+            pv.value = pv.value.at[:, phys, off].set(vT)
+            return paged_attention(q, pk.value, pv.value, bt.value,
+                                   cur.value, scale=d ** -0.5)
+        # quantize-on-write (chunked prefill and decode scatter are
+        # this one path).  Scale discipline per (kv_head, page):
+        # - RESET at a page's first write: pages always begin life at
+        #   offset 0 (sequential fill from a block boundary), so the
+        #   offset-0 tokens of this chunk mark fresh pages and clear
+        #   any stale scale left by the page's previous tenant (the
+        #   non-fresh lane of the scatter is routed to the null page);
+        # - each token contributes its row's MONOTONE RUNNING AMAX —
+        #   cummax over the chunk seeded from the scale of the row's
+        #   most recent written page, which by induction is the
+        #   running amax of the whole prefix — scatter-MAXed into its
+        #   page, so the scale only ever grows and codes already
+        #   written never clip and never need rewriting.  Chaining
+        #   through the previous page (instead of a per-page region
+        #   amax) is what makes rescale-on-append RARE: the running
+        #   amax saturates over the prompt, so a partially-filled
+        #   page's scale almost never moves under decode appends and
+        #   the residual inflation of earlier codes is bounded by the
+        #   sequence-level amax drift across one <= block_size-token
+        #   page.  Page scales stay a pure function of the row's
+        #   tokens 0..page-end — chunk-alignment-invariant, which is
+        #   what lets shared/CoW-forked pages reproduce bitwise
+        #   (tests/test_paged_serving.py::TestQuantizedKV).
+        # pad lanes (>= the row's chunk_lens) route to the NULL page:
+        # their K/V would be position-masked and overwritten anyway,
+        # but the scale scatter-max below is MONOTONE — one garbage
+        # pad amax would stick in a live page's scale forever
+        real = (jnp.arange(s, dtype=jnp.int32)[None, :]
+                < cl.value[:, None])                         # (b, s)
+        phys = jnp.where(real, phys, 0)
+        ka = jnp.max(jnp.abs(kT.astype(jnp.float32)), axis=-1)
+        va = jnp.max(jnp.abs(vT.astype(jnp.float32)), axis=-1)
+        ka = jnp.where(real[None], ka, 0.0)                  # (hk, b, s)
+        va = jnp.where(real[None], va, 0.0)
+        base_logical = jnp.clip((cur.value - 1) // BS, 0, MB - 1)
+        base_phys = jnp.take_along_axis(
+            bt.value, base_logical[:, None], axis=1)[:, 0]   # (b,)
+        has_prefix = cur.value > 0                           # (b,)
+        k_base = jnp.where(has_prefix[None, :],
+                           ksc.value[:, base_phys], 0.0)     # (hk, b)
+        v_base = jnp.where(has_prefix[None, :],
+                           vsc.value[:, base_phys], 0.0)
+        k_run = jnp.maximum(jax.lax.cummax(ka, axis=2),
+                            k_base[:, :, None])              # (hk, b, s)
+        v_run = jnp.maximum(jax.lax.cummax(va, axis=2),
+                            v_base[:, :, None])
+        fresh = jnp.where(off == 0, phys, 0)                 # (b, s)
+        ks_new = ksc.value.at[:, fresh].set(0.0).at[:, phys].max(k_run)
+        vs_new = vsc.value.at[:, fresh].set(0.0).at[:, phys].max(v_run)
+        ksc.value, vsc.value = ks_new, vs_new
+        pk.value = pk.value.at[:, phys, off].set(
+            quantize_kv(kT, ks_new[:, phys], qmax, store_dt))
+        pv.value = pv.value.at[:, phys, off].set(
+            quantize_kv(vT, vs_new[:, phys], qmax, store_dt))
         return paged_attention(q, pk.value, pv.value, bt.value,
-                               cur.value, scale=d ** -0.5)
+                               cur.value, scale=d ** -0.5,
+                               k_scales=ks_new, v_scales=vs_new)
 
     @nn.compact
     def __call__(self, x, *, mask_bias=None, deterministic: bool = True,

@@ -42,8 +42,9 @@ import pathlib
 import time
 from typing import Dict, Iterable, Optional
 
-__all__ = ["cached_block_rows", "tune_layer_norm", "tune_softmax",
-           "tune_batch_norm", "tune_paged_attention", "clear_cache"]
+__all__ = ["cached_block_rows", "cached_paged_pair", "tune_layer_norm",
+           "tune_softmax", "tune_batch_norm", "tune_paged_attention",
+           "clear_cache"]
 
 _CACHE: Optional[Dict[str, int]] = None
 
@@ -92,6 +93,19 @@ def cached_block_rows(op: str, width: int, dtype) -> Optional[int]:
     return _load().get(_key(op, width, dtype))
 
 
+def cached_paged_pair(width: int, dtype) -> Optional[tuple]:
+    """Measured best ``(block_size, kv_dtype)`` pair for the paged
+    decode step at head_dim ``width`` and COMPUTE dtype ``dtype``
+    (``kv_dtype`` is ``None`` when the unquantized pool won), or None
+    if :func:`tune_paged_attention` never ran its joint sweep here.
+    ``PagedEngine(block_size=0, kv_dtype="auto")`` adopts this pair."""
+    val = _load().get(_key("paged_attention_pair", width, dtype))
+    if val is None:
+        return None
+    bs, kvd = val
+    return int(bs), (None if kvd in (None, "none") else str(kvd))
+
+
 def clear_cache() -> None:
     """Drop the in-memory cache (tests; the file is left alone)."""
     global _CACHE
@@ -115,6 +129,26 @@ def _time_call(fn, *args, iters: int = 10, warmup: int = 2) -> float:
     return (time.perf_counter() - t0) / iters
 
 
+def _best_candidate(build_fn, candidates: Iterable[int],
+                    n_rows: Optional[int] = None) -> tuple:
+    """Time ``build_fn(c)`` over the candidates (multiples of 8 only,
+    ``c <= n_rows`` when given; candidates that fail to build/compile
+    are skipped) and return ``(winner, seconds)`` — ``(None, inf)``
+    when nothing measured."""
+    best, best_dt = None, float("inf")
+    for c in candidates:
+        if c % 8 or (n_rows is not None and c > n_rows):
+            continue
+        try:
+            fn, args = build_fn(c)
+            dt = _time_call(fn, *args)
+        except Exception:
+            continue
+        if dt < best_dt:
+            best, best_dt = c, dt
+    return best, best_dt
+
+
 def _tune(op: str, build_fn, n_rows: int, width: int, dtype,
           candidates: Iterable[int]) -> int:
     """Time ``build_fn(block_rows)`` over the candidates, cache and
@@ -122,17 +156,7 @@ def _tune(op: str, build_fn, n_rows: int, width: int, dtype,
     import jax.numpy as jnp
 
     dtype = jnp.dtype(dtype)
-    best, best_dt = None, float("inf")
-    for br in candidates:
-        if br > n_rows or br % 8:
-            continue
-        try:
-            fn, args = build_fn(br)
-            dt = _time_call(fn, *args)
-        except Exception:
-            continue
-        if dt < best_dt:
-            best, best_dt = br, dt
+    best, _ = _best_candidate(build_fn, candidates, n_rows=n_rows)
     if best is not None:
         _store(_key(op, width, str(dtype)), best)
     return best
@@ -220,22 +244,41 @@ def tune_paged_attention(n_rows: int = 8, width: int = 128,
                          dtype="bfloat16", kv_heads: int = 8,
                          live_tokens: int = 1024,
                          candidates: Iterable[int] = (8, 16, 32, 64,
-                                                      128)) -> int:
-    """Sweep the paged KV-cache **page size** (tokens per block) for
-    the decode step at (batch=``n_rows``, head_dim=``width``).
+                                                      128),
+                         kv_dtypes: Optional[Iterable] = None) -> tuple:
+    """Jointly sweep the paged KV-cache **page size** (tokens per
+    block) and **pool storage dtype** for the decode step at
+    (batch=``n_rows``, head_dim=``width``).
 
-    Unlike the row-wise sweeps the tunable here is the cache *layout*
-    parameter itself: small pages waste less pool on the last partial
-    page per sequence but issue more (and smaller) gather DMAs per
-    step; large pages amortize the DMA at the cost of internal
-    fragmentation.  The pool is sized to the sweep (``n_rows`` rows at
+    Unlike the row-wise sweeps the tunables here are cache *layout*
+    parameters: small pages waste less pool on the last partial page
+    per sequence but issue more (and smaller) gather DMAs per step;
+    large pages amortize the DMA at the cost of internal
+    fragmentation; and a quantized pool (``kv_dtype="int8"`` /
+    ``"fp8"``, ISSUE 8) halves-to-quarters the bytes each gather moves
+    at the cost of the in-kernel dequant multiply — on an HBM-bound
+    decode step the 1-byte pages usually win outright, and the best
+    page size can shift with the storage width (the DMA payload per
+    page shrinks).  The pool is sized to the sweep (``n_rows`` rows at
     ``live_tokens`` live, shuffled physical placement), so any
-    rows/width combination measures.  The serving engine
-    (``apex_tpu.serving.PagedEngine``) picks the measured winner up by
-    default when ``block_size`` is not given; its lookup key is
-    (device, "paged_attention", **head_dim**, dtype) — from the CLI
-    pass the model's head_dim as ``--widths`` (NOT the hidden size)
-    and the serving batch as ``--rows``::
+    rows/width combination measures.
+
+    ``kv_dtypes`` defaults to every storage the build supports:
+    ``(None, "int8")`` plus ``"fp8"`` where ``jnp.float8_e4m3fn``
+    exists.  Two kinds of cache entries are written:
+
+    - per-STORAGE-dtype block-size winners under the engine's
+      ``block_size=0`` lookup key (device, "paged_attention",
+      head_dim, storage dtype) — ``kv_dtype=None`` keys the compute
+      dtype, exactly as before;
+    - the joint ``(block_size, kv_dtype)`` winner under
+      "paged_attention_pair" keyed on the COMPUTE dtype, which
+      ``PagedEngine(block_size=0, kv_dtype="auto")`` adopts via
+      :func:`cached_paged_pair`.
+
+    Returns the joint winner as ``(block_size, kv_dtype)``.  From the
+    CLI pass the model's head_dim as ``--widths`` (NOT the hidden
+    size) and the serving batch as ``--rows``::
 
         python -m apex_tpu.ops.autotune --ops paged_attention \\
             --widths 128 --rows 16
@@ -244,33 +287,64 @@ def tune_paged_attention(n_rows: int = 8, width: int = 128,
     import jax.numpy as jnp
     import numpy as np
 
-    from apex_tpu.ops.paged_attention import paged_attention as _paged
+    from apex_tpu.ops.paged_attention import (
+        kv_quant_spec,
+        paged_attention as _paged,
+        quantize_kv_pages,
+    )
 
     # n_rows arrives from the shared --rows CLI flag whose row-wise
     # default (8192) means activation rows; a decode BATCH that size
     # is meaningless and would OOM the pool — clamp to serving scale
     n_rows = max(1, min(int(n_rows), 256))
     dt = jnp.dtype(dtype)
+    if kv_dtypes is None:
+        kv_dtypes = [None, "int8"]
+        try:
+            kv_quant_spec("fp8")
+            kv_dtypes.append("fp8")
+        except ValueError:
+            pass           # no float8_e4m3fn in this jax build
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.normal(size=(n_rows, 1, kv_heads, width)), dt)
 
-    def build(bs):
+    def build(bs, kvd):
         mb = -(-live_tokens // bs)
         nb = n_rows * mb + 1           # pool sized to the sweep
         kp = jnp.asarray(
             rng.normal(size=(kv_heads, nb, bs, width)), dt)
         vp = jnp.asarray(
             rng.normal(size=(kv_heads, nb, bs, width)), dt)
+        if kvd is not None:
+            kp, vp, ks, vs = quantize_kv_pages(kp, vp, kvd)
         free = np.arange(1, nb, dtype=np.int32)
         rng.shuffle(free)
         tables = free[: n_rows * mb].reshape(n_rows, mb).copy()
         lengths = jnp.full((n_rows,), live_tokens - 1, jnp.int32)
-        fn = jax.jit(lambda q: _paged(
-            q, kp, vp, jnp.asarray(tables), lengths))
+        if kvd is None:
+            fn = jax.jit(lambda q: _paged(
+                q, kp, vp, jnp.asarray(tables), lengths))
+        else:
+            fn = jax.jit(lambda q: _paged(
+                q, kp, vp, jnp.asarray(tables), lengths,
+                k_scales=ks, v_scales=vs))
         return fn, (q,)
 
-    return _tune("paged_attention", build, 10 ** 9, width, str(dt),
-                 candidates)
+    best_pair, best_pair_dt = None, float("inf")
+    for kvd in kv_dtypes:
+        store_dt, _ = kv_quant_spec(kvd)
+        key_dt = str(dt) if store_dt is None else str(jnp.dtype(store_dt))
+        best_bs, best_dt_s = _best_candidate(
+            lambda bs, kvd=kvd: build(bs, kvd), candidates)
+        if best_bs is None:
+            continue
+        _store(_key("paged_attention", width, key_dt), best_bs)
+        if best_dt_s < best_pair_dt:
+            best_pair, best_pair_dt = (best_bs, kvd), best_dt_s
+    if best_pair is not None:
+        _store(_key("paged_attention_pair", width, str(dt)),
+               [best_pair[0], best_pair[1] or "none"])
+    return best_pair
 
 
 def main(argv=None):
@@ -291,8 +365,14 @@ def main(argv=None):
                     "batch_norm": tune_batch_norm,
                     "paged_attention": tune_paged_attention}[op]
             best = tune(n_rows=args.rows, width=width, dtype=args.dtype)
-            print(f"{op} w={width}: best block_rows={best} "
-                  f"(cache: {_cache_path()})")
+            if op == "paged_attention":
+                bs, kvd = best if best else (None, None)
+                print(f"{op} w={width}: best block_size={bs} "
+                      f"kv_dtype={kvd or 'none'} "
+                      f"(cache: {_cache_path()})")
+            else:
+                print(f"{op} w={width}: best block_rows={best} "
+                      f"(cache: {_cache_path()})")
 
 
 if __name__ == "__main__":

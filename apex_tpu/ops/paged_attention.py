@@ -48,6 +48,26 @@ write-then-attend overwrites it.  A verify chunk is just a decode
 chunk whose ``s = 1 + spec_tokens`` — no dedicated kernel variant, no
 extra executable.
 
+**Quantized KV pages (``k_scales``/``v_scales``)**: the pool may store
+int8 or fp8 (``float8_e4m3fn``) codes instead of bf16/fp32 K/V — the
+ISSUE-8 capacity lever: at 1 byte/element the same HBM holds ~2× (bf16)
+to ~4× (fp32) the tokens, which the serving engine converts into
+admitted occupancy.  Quantization is symmetric per **(kv_head, page)**:
+``code = round(x · qmax / scale)`` (int8, ``qmax = 127``) or a
+saturating fp8 cast (``qmax = 448``), with ``scale`` the page region's
+running amax, stored in fp32 ``(kv_heads, num_blocks)`` arrays that
+live beside the block table and travel with the page through sharing /
+CoW / preemption.  Dequant happens **in-register inside the kernel**:
+the per-page scale is a scalar over the ``(block_size, head_dim)``
+tile, so it factors out of the score and value contractions — the
+kernel DMAs 1-byte pages plus one f32 scalar per page per side and
+multiplies after the dot, before the log2-domain online softmax.  The
+XLA reference dequantizes the gathered pages explicitly (the parity
+anchor); both paths are exercised by
+``tests/test_paged_attention.py::TestQuantizedKernel``.  Without
+scales (``kv_dtype=None`` upstream) every code path below is
+byte-identical to the unquantized module.
+
 Two implementations under the :mod:`apex_tpu.ops._dispatch`
 conventions:
 
@@ -84,17 +104,142 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._dispatch import resolve_impl
 
-__all__ = ["paged_attention", "paged_attention_reference"]
+__all__ = ["paged_attention", "paged_attention_reference",
+           "kv_quant_spec", "kv_store_bytes_per_token", "quantize_kv",
+           "quantize_kv_pages"]
 
 _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634
+
+#: fp8 storage dtype when this jax build ships one (ml_dtypes-backed)
+_FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+
+#: storage dtype → qmax, the one table behind kv_quant_spec (name →
+#: spec) and the pool-dtype lookups below — a storage dtype absent
+#: here cannot silently dequantize with a wrong divisor
+_QMAX_BY_DTYPE = {jnp.dtype(jnp.int8): 127.0}
+if _FP8_DTYPE is not None:
+    _QMAX_BY_DTYPE[jnp.dtype(_FP8_DTYPE)] = 448.0
+
+# scales below qmax/float32_max would overflow the quantization
+# multiplier to +inf (0 * inf = NaN poisons zero K/V) — same guard as
+# the int8 AllReduce in parallel/ddp.py
+_TINY_SCALE = 448.0 / float(jnp.finfo(jnp.float32).max)
+
+
+def kv_quant_spec(kv_dtype):
+    """Resolve a KV-pool quantization name to ``(storage_dtype, qmax)``.
+
+    ``None`` → ``(None, None)`` (unquantized pool, the default);
+    ``"int8"`` → ``(int8, 127.0)``; ``"fp8"`` → ``(float8_e4m3fn,
+    448.0)`` where the jax build supports it (a loud ``ValueError``
+    otherwise — silently falling back to int8 would change numerics
+    behind the caller's back).  The single source of truth for every
+    ``kv_dtype=`` knob (``TransformerConfig`` / ``PagedEngine`` /
+    ``InferenceServer`` / autotune / bench traffic model).
+    """
+    if kv_dtype is None:
+        return None, None
+    if kv_dtype == "int8":
+        return jnp.int8, _QMAX_BY_DTYPE[jnp.dtype(jnp.int8)]
+    if kv_dtype == "fp8":
+        if _FP8_DTYPE is None:
+            raise ValueError(
+                "kv_dtype='fp8' needs a jax build with "
+                "jnp.float8_e4m3fn (this one has none) — use "
+                "kv_dtype='int8', which every build supports")
+        return _FP8_DTYPE, _QMAX_BY_DTYPE[jnp.dtype(_FP8_DTYPE)]
+    raise ValueError(
+        f"kv_dtype={kv_dtype!r} not in (None, 'int8', 'fp8')")
+
+
+def kv_store_bytes_per_token(head_dim, block_size, kv_dtype=None, *,
+                             dtype=None):
+    """Pool bytes per cached token per (kv_head, layer).
+
+    K+V codes at the storage width plus, under quantization, the two
+    fp32 page scales amortized over ``block_size`` tokens.  THE single
+    formula behind ``PagedEngine``'s equal-HBM ``pool_tokens`` default,
+    the bench ``_serving_traffic_model`` capacity rows, and the
+    ``quantized_kv_serving`` leg's byte budget — one site to change if
+    the scale granularity ever does, so engine-admitted capacity and
+    the analytic model can't silently disagree.  ``dtype`` (the compute
+    dtype) is only consulted for an unquantized pool
+    (``kv_dtype=None``); multiply by ``kv_heads × num_layers`` for a
+    whole model's per-token footprint.
+    """
+    store_dt, _ = kv_quant_spec(kv_dtype)
+    if store_dt is None:
+        if dtype is None:
+            raise ValueError(
+                "dtype is required for an unquantized pool "
+                "(kv_dtype=None)")
+        return 2 * int(head_dim) * jnp.dtype(dtype).itemsize
+    return (2 * int(head_dim) * jnp.dtype(store_dt).itemsize
+            + 2 * 4.0 / int(block_size))
+
+
+def quantize_kv(x, scales, qmax, dtype):
+    """Symmetric quantization of ``x`` against per-row amax ``scales``.
+
+    ``x`` ``(..., d)`` float; ``scales`` ``(...)`` fp32 amax — each
+    row's last axis is scaled by ``qmax/scale`` and cast to ``dtype``
+    (rounded first for integer codes; the fp8 cast rounds itself).
+    ``scale == 0`` marks an all-zero row and quantizes to exact 0; the
+    near-zero guard keeps ``qmax/scale`` finite.  Clipping only ever
+    engages when ``scale`` is *stale-smaller* than the row's amax —
+    with the write path's monotone running amax that cannot happen, so
+    the codes are exact round-to-nearest at all times.
+    """
+    scales = scales.astype(jnp.float32)
+    ok = scales > _TINY_SCALE
+    inv = jnp.where(ok, qmax / jnp.maximum(scales, _TINY_SCALE), 0.0)
+    y = jnp.clip(x.astype(jnp.float32) * inv[..., None], -qmax, qmax)
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        y = jnp.round(y)
+    return y.astype(dtype)
+
+
+def quantize_kv_pages(k_pages, v_pages, kv_dtype):
+    """Quantize a full float K/V pool to ``kv_dtype`` pages + scales.
+
+    Per-(kv_head, page) amax over the ``(block_size, head_dim)`` tile —
+    the same granularity the serving write path maintains
+    incrementally.  Returns ``(kq, vq, k_scales, v_scales)`` with
+    scales of shape ``(kv_heads, num_blocks)`` fp32.  Test/offline
+    helper (autotune sweeps, golden fixtures): the engine never
+    quantizes a whole pool at once, it quantizes each write.
+    """
+    store_dt, qmax = kv_quant_spec(kv_dtype)
+    if store_dt is None:
+        raise ValueError("quantize_kv_pages needs kv_dtype in "
+                         "('int8', 'fp8'), got None")
+    ks = jnp.max(jnp.abs(k_pages.astype(jnp.float32)), axis=(2, 3))
+    vs = jnp.max(jnp.abs(v_pages.astype(jnp.float32)), axis=(2, 3))
+    kq = quantize_kv(k_pages, ks[:, :, None], qmax, store_dt)
+    vq = quantize_kv(v_pages, vs[:, :, None], qmax, store_dt)
+    return kq, vq, ks, vs
+
+
+def _is_quantized_pool(dtype) -> bool:
+    return jnp.dtype(dtype) in _QMAX_BY_DTYPE
+
+
+def _qmax_for_pool(dtype) -> float:
+    try:
+        return _QMAX_BY_DTYPE[jnp.dtype(dtype)]
+    except KeyError:
+        raise ValueError(
+            f"no KV quantization spec for pool dtype {jnp.dtype(dtype)}"
+        ) from None
 
 
 # --------------------------------------------------------------------- #
 # XLA reference (golden semantics; CPU/GPU fallback)
 # --------------------------------------------------------------------- #
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
-                              lengths, *, scale: Optional[float] = None):
+                              lengths, *, scale: Optional[float] = None,
+                              k_scales=None, v_scales=None):
     """Gather-then-attend reference: softmax(q·K_gatheredᵀ·scale)·V.
 
     Shapes as in the module docstring.  The gather materializes each
@@ -103,16 +248,31 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
     pool garbage beyond ``lengths[b] + i`` is unreachable.  fp32
     softmax, output in ``q.dtype`` — the same numerics contract as the
     dense engine's cache attention.
+
+    With quantized pages (``k_scales``/``v_scales`` given, one fp32
+    amax per (kv_head, pool block)), the GATHERED pages are dequantized
+    explicitly — ``code · scale / qmax`` in fp32, scales gathered
+    through the same block table — so the cost stays O(live pages),
+    never O(pool): the quantize-dequant parity anchor the Pallas
+    kernel's in-register dequant is tested against.
     """
     b, s, h, d = q.shape
     hk, _nb, bs, _ = k_pages.shape
     rep = h // hk
     scale = (d ** -0.5) if scale is None else scale
     mb = block_tables.shape[1]
-    # (hk, b, mb, bs, d) -> (b, mb*bs, hk, d): logical order restored,
+    # (hk, b, mb, bs, d) -> (b, mb, bs, hk, d): logical order restored,
     # so key position == gathered index
     keys = jnp.moveaxis(k_pages[:, block_tables], 0, 3)
     vals = jnp.moveaxis(v_pages[:, block_tables], 0, 3)
+    if k_scales is not None:
+        qmax = _qmax_for_pool(k_pages.dtype)
+        ks = jnp.moveaxis(k_scales[:, block_tables], 0, 2)  # (b, mb, hk)
+        vs = jnp.moveaxis(v_scales[:, block_tables], 0, 2)
+        keys = (keys.astype(jnp.float32)
+                * (ks.astype(jnp.float32) / qmax)[:, :, None, :, None])
+        vals = (vals.astype(jnp.float32)
+                * (vs.astype(jnp.float32) / qmax)[:, :, None, :, None])
     keys = keys.reshape(b, mb * bs, hk, d)
     vals = vals.reshape(b, mb * bs, hk, d)
     qg = q.reshape(b, s, hk, rep, d).astype(jnp.float32)
@@ -130,8 +290,8 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
 # --------------------------------------------------------------------- #
 # Pallas TPU kernel
 # --------------------------------------------------------------------- #
-def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_ref, l_ref, acc_ref, *, bs, s, rep, scale, nb):
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *refs,
+                  bs, s, rep, scale, nb, qmax=None):
     """One (row, kv-head, page) step of the online-softmax sweep.
 
     Score tiles are TRANSPOSED — (bs, rep·s): key slots on sublanes,
@@ -139,7 +299,24 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
     lane rows and the value accumulation contracts over the page at
     full MXU shape (the ops/attention.py layout, measured there).
     Lane ``l`` holds q head ``l // s`` at chunk offset ``l % s``.
+
+    ONE body serves both pool widths (the masking/softmax algebra must
+    never fork).  With ``qmax`` set, ``k_ref``/``v_ref`` hold int8/fp8
+    codes and two extra refs — ``ks_ref``/``vs_ref``, the pages' fp32
+    amax scales, DMA-ed through the same block-table index map as
+    their pages (one ``(1, 1)`` scalar per step) — precede the output.
+    The per-page dequant multiplier ``scale/qmax`` is CONSTANT over
+    the ``(bs, d)`` tile, so it factors out of both contractions:
+    codes are cast up (exact — |int8| ≤ 127 and e4m3 fit any float)
+    for the MXU dot, and the product is rescaled in-register before
+    the log2-domain softmax statistics (scores) / the output
+    accumulation (values).
     """
+    if qmax is None:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
     row = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -154,9 +331,14 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     def _step():
         qs = q_ref[0, 0] * jnp.asarray(scale * _LOG2E, q_ref.dtype)
+        kq = (k_ref[0, 0] if qmax is None
+              else k_ref[0, 0].astype(qs.dtype))     # exact upcast
         sc = jax.lax.dot_general(
-            k_ref[0, 0], qs, (((1,), (1,)), ((), ())),
+            kq, qs, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # (bs, rep*s)
+        if qmax is not None:
+            # in-register dequant: one f32 multiply per score tile
+            sc = sc * (ks_ref[0, 0] * jnp.float32(1.0 / qmax))
         k_pos = j * bs + jax.lax.broadcasted_iota(
             jnp.int32, (bs, rep * s), 0)
         q_off = jax.lax.broadcasted_iota(
@@ -171,8 +353,14 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp2(sc - m_new)
         alpha = jnp.exp2(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=0, keepdims=True)
+        if qmax is None:
+            vq, pv = v_ref[0, 0], p.astype(v_ref.dtype)
+        else:
+            vq = (v_ref[0, 0].astype(jnp.float32)
+                  * (vs_ref[0, 0] * jnp.float32(1.0 / qmax)))
+            pv = p
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            v_ref[0, 0], p.astype(v_ref.dtype), (((0,), (0,)), ((), ())),
+            vq, pv, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # (d, rep*s)
         m_ref[:] = m_new
 
@@ -190,7 +378,8 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
             o_ref.dtype)
 
 
-def _run_paged(q4, k_pages, v_pages, tables, lengths, scale, interpret):
+def _run_paged(q4, k_pages, v_pages, tables, lengths, scale, interpret,
+               k_scales=None, v_scales=None):
     b, s, h, d = q4.shape
     hk, _nb_pool, bs, _ = k_pages.shape
     rep = h // hk
@@ -206,15 +395,34 @@ def _run_paged(q4, k_pages, v_pages, tables, lengths, scale, interpret):
         live = jnp.maximum(lens_ref[row] + s - 1, 0) // bs
         return head, tables_ref[row, jnp.minimum(j, live)], 0, 0
 
+    def _scale_map(row, head, j, tables_ref, lens_ref):
+        # the page's scale rides the same logical→physical resolution
+        live = jnp.maximum(lens_ref[row] + s - 1, 0) // bs
+        return head, tables_ref[row, jnp.minimum(j, live)]
+
+    quantized = k_scales is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, rep * s, d),
+                     lambda row, head, j, *_: (row, head, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), _kv_map),
+        pl.BlockSpec((1, 1, bs, d), _kv_map),
+    ]
+    args = [tables, lengths, q3, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1), _scale_map),
+                     pl.BlockSpec((1, 1), _scale_map)]
+        args += [k_scales.astype(jnp.float32),
+                 v_scales.astype(jnp.float32)]
+        kernel = functools.partial(
+            _paged_kernel, bs=bs, s=s, rep=rep, scale=scale,
+            nb=mb, qmax=_qmax_for_pool(k_pages.dtype))
+    else:
+        kernel = functools.partial(_paged_kernel, bs=bs, s=s, rep=rep,
+                                   scale=scale, nb=mb)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hk, mb),
-        in_specs=[
-            pl.BlockSpec((1, 1, rep * s, d),
-                         lambda row, head, j, *_: (row, head, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d), _kv_map),
-            pl.BlockSpec((1, 1, bs, d), _kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, rep * s, d),
             lambda row, head, j, *_: (row, head, 0, 0)),
@@ -224,14 +432,12 @@ def _run_paged(q4, k_pages, v_pages, tables, lengths, scale, interpret):
             pltpu.VMEM((d, rep * s), jnp.float32),   # transposed acc
         ],
     )
-    kernel = functools.partial(_paged_kernel, bs=bs, s=s, rep=rep,
-                               scale=scale, nb=mb)
     o3 = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hk, rep * s, d), q4.dtype),
         interpret=interpret,
-    )(tables, lengths, q3, k_pages, v_pages)
+    )(*args)
     return (o3.reshape(b, hk, rep, s, d)
             .transpose(0, 3, 1, 2, 4).reshape(b, s, h, d))
 
@@ -241,7 +447,8 @@ def _run_paged(q4, k_pages, v_pages, tables, lengths, scale, interpret):
 # --------------------------------------------------------------------- #
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
                     scale: Optional[float] = None,
-                    implementation: Optional[str] = None):
+                    implementation: Optional[str] = None,
+                    k_scales=None, v_scales=None):
     """Attention of chunk queries over a paged KV pool (shapes in the
     module docstring).
 
@@ -255,13 +462,19 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
     and ``head_dim`` multiples of 8, GQA head ratio integral) and the
     gather reference elsewhere; the serving engine's ``kv_cache="dense"``
     slab path remains the non-paged fallback one level up.
+
+    Quantized pools (int8 / fp8 pages) REQUIRE ``k_scales``/``v_scales``
+    — ``(kv_heads, num_blocks)`` fp32 per-page amax arrays (see the
+    module docstring); passing scales with a float pool (or omitting
+    them with a quantized one) raises.  The verify chunk and every
+    other ``s`` ride the identical quantized path — no extra variant.
     """
     b, s, h, d = q.shape
     if k_pages.shape != v_pages.shape:
         raise ValueError(
             f"k_pages/v_pages shapes differ: {k_pages.shape} vs "
             f"{v_pages.shape}")
-    hk, _nb, bs, dk = k_pages.shape
+    hk, nb, bs, dk = k_pages.shape
     if dk != d:
         raise ValueError(
             f"head_dim mismatch: q has {d}, pages have {dk}")
@@ -272,14 +485,37 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
         raise ValueError(
             f"block_tables {block_tables.shape} / lengths "
             f"{lengths.shape} do not match batch {b}")
+    quantized = _is_quantized_pool(k_pages.dtype)
+    if quantized:
+        if k_pages.dtype != v_pages.dtype:
+            raise ValueError(
+                f"k_pages/v_pages dtypes differ: {k_pages.dtype} vs "
+                f"{v_pages.dtype}")
+        if k_scales is None or v_scales is None:
+            raise ValueError(
+                f"quantized pages ({k_pages.dtype}) need k_scales AND "
+                "v_scales (per-page fp32 amax arrays)")
+        for name, sc in (("k_scales", k_scales),
+                         ("v_scales", v_scales)):
+            if sc.shape != (hk, nb):
+                raise ValueError(
+                    f"{name} shape {sc.shape} != (kv_heads, "
+                    f"num_blocks) = {(hk, nb)}")
+    elif k_scales is not None or v_scales is not None:
+        raise ValueError(
+            f"k_scales/v_scales only apply to quantized pools; pages "
+            f"are {k_pages.dtype}")
     scale = (d ** -0.5) if scale is None else float(scale)
     pallas_ok = (bs % 8 == 0 and d % 8 == 0
-                 and q.dtype == k_pages.dtype == v_pages.dtype)
+                 and (quantized
+                      or q.dtype == k_pages.dtype == v_pages.dtype))
     impl = resolve_impl(implementation, pallas_ok=pallas_ok)
     if impl == "xla" or not pallas_ok:
         return paged_attention_reference(
-            q, k_pages, v_pages, block_tables, lengths, scale=scale)
+            q, k_pages, v_pages, block_tables, lengths, scale=scale,
+            k_scales=k_scales, v_scales=v_scales)
     return _run_paged(q, k_pages, v_pages,
                       jnp.asarray(block_tables, jnp.int32),
                       jnp.asarray(lengths, jnp.int32), scale,
-                      impl == "pallas_interpret")
+                      impl == "pallas_interpret",
+                      k_scales=k_scales, v_scales=v_scales)

@@ -201,7 +201,8 @@ class InferenceServer:
                  pool_tokens: Optional[int] = None,
                  admit_headroom: Optional[int] = None,
                  share_prefixes: bool = False,
-                 spec_tokens: int = 0, spec_ngram: int = 3):
+                 spec_tokens: int = 0, spec_ngram: int = 3,
+                 kv_dtype: Optional[str] = None):
         if kv_cache == "paged":
             if prompt_buckets is not None:
                 raise ValueError(
@@ -217,7 +218,8 @@ class InferenceServer:
                 prefill_chunk=prefill_chunk or 32,
                 admit_headroom=admit_headroom,
                 share_prefixes=share_prefixes,
-                spec_tokens=spec_tokens, spec_ngram=spec_ngram)
+                spec_tokens=spec_tokens, spec_ngram=spec_ngram,
+                kv_dtype=kv_dtype)
         elif kv_cache == "dense":
             if share_prefixes or spec_tokens:
                 raise ValueError(
@@ -225,6 +227,12 @@ class InferenceServer:
                     "kv_cache='paged' — the dense slab has no page "
                     "pool to share and no mixed multi-token step to "
                     "verify drafts in")
+            if kv_dtype is not None:
+                raise ValueError(
+                    "kv_dtype requires kv_cache='paged' — quantized "
+                    "KV pages live in the paged pool (per-page "
+                    "scales beside the block table); the dense slab "
+                    "stores K/V in the model's compute dtype")
             self.engine = Engine(
                 model, params, max_slots=max_slots,
                 prompt_buckets=(DEFAULT_BUCKETS if prompt_buckets
@@ -622,6 +630,10 @@ class InferenceServer:
             # spec-disabled replicas' hardwired 0.0 would dilute it
             payload["shared_blocks"] = self.engine.shared_blocks
             payload["cow_forks"] = self.engine.cow_forks
+            # pool storage width (8 = quantized int8/fp8 pages) —
+            # numeric so any sink can plot/aggregate it; the dtype
+            # NAME rides health()
+            payload["kv_bits"] = self.engine.kv_bits
             if getattr(self.engine, "spec_tokens", 0):
                 payload["spec_accept_rate"] = \
                     self.engine.spec_accept_rate
@@ -682,6 +694,8 @@ class InferenceServer:
             out["live_tokens"] = self.engine.live_tokens
             out["shared_blocks"] = self.engine.shared_blocks
             out["cow_forks"] = self.engine.cow_forks
+            out["kv_dtype"] = self.engine.kv_dtype
+            out["kv_bits"] = self.engine.kv_bits
             if getattr(self.engine, "spec_tokens", 0):
                 out["spec_accept_rate"] = self.engine.spec_accept_rate
         return out

@@ -218,6 +218,7 @@ __all__ += ["admit_slot", "release_slot"]
 # the model level for learned-position models)
 _TABLE_LEAF = "block_tables"
 _CURSOR_LEAVES = ("cursors", "position_index")
+_CHUNK_LENS_LEAF = "chunk_lens"
 
 
 class BlockExhausted(RuntimeError):
@@ -243,6 +244,15 @@ class BlockAllocator:
     reserved **null page**: unallocated block-table entries point at
     it, pad-token writes land in it, and the position mask keeps its
     contents unreachable — so it is never handed out.
+
+    The allocator counts PAGES and is storage-dtype-agnostic: under a
+    quantized pool (``kv_dtype="int8"``/``"fp8"``, ISSUE 8) the same
+    page index addresses 1-byte K/V codes plus one fp32 amax scale per
+    (kv_head, page) riding the cache beside the block table — a page's
+    scale travels with it through sharing, CoW forks, preemption and
+    reuse (the write path resets it at the page's first write), so
+    nothing below this line changes; only how many tokens the same HBM
+    buys does.
 
     Pages carry a **refcount** (the prefix-sharing substrate, ISSUE 7):
     :meth:`alloc` hands out pages at refcount 1, :meth:`incref` lets a
@@ -455,16 +465,28 @@ class PrefixTrie:
         return pages
 
 
-def set_paged_leaves(cache: Any, tables, cursors) -> Any:
+def set_paged_leaves(cache: Any, tables, cursors,
+                     chunk_lens=None) -> Any:
     """Overwrite the paged cache tree's ``block_tables`` and cursor
     leaves (``cursors`` / ``position_index``) with the engine's
     host-authoritative values, broadcast to each leaf's shape (the
     scanned layer stack adds a leading layer axis — every layer shares
     one logical→physical mapping because the per-layer pools are
-    parallel).  K/V pool leaves pass through untouched.
+    parallel).  ``chunk_lens`` (per-row REAL lane counts for the
+    coming mixed step) overwrites the quantized pool's ``chunk_lens``
+    leaf the same way — the write path routes lanes past it to the
+    null page so pad-lane amax never reaches a live page scale; pass
+    ``None`` to leave the leaf untouched (non-engine callers keep the
+    model's every-lane-real default, and unquantized pools have no
+    such leaf).  K/V pool leaves — and, under a quantized pool, the
+    ``key_scales``/``value_scales`` per-page amax leaves that ride
+    beside them — pass through untouched: the model's write path owns
+    them.
     """
     tables = jnp.asarray(tables, jnp.int32)
     cursors = jnp.asarray(cursors, jnp.int32)
+    if chunk_lens is not None:
+        chunk_lens = jnp.asarray(chunk_lens, jnp.int32)
 
     def fix(path, leaf):
         name = _leaf_name(path)
@@ -472,6 +494,9 @@ def set_paged_leaves(cache: Any, tables, cursors) -> Any:
             return jnp.broadcast_to(tables, leaf.shape).astype(leaf.dtype)
         if name in _CURSOR_LEAVES:
             return jnp.broadcast_to(cursors, leaf.shape).astype(leaf.dtype)
+        if name == _CHUNK_LENS_LEAF and chunk_lens is not None:
+            return jnp.broadcast_to(chunk_lens,
+                                    leaf.shape).astype(leaf.dtype)
         return leaf
 
     return jax.tree_util.tree_map_with_path(fix, cache)

@@ -522,12 +522,40 @@ class PagedEngine:
     split — so greedy AND sampled chains are token-identical to
     ``generate()`` regardless of the acceptance pattern.
 
+    **Quantized KV pages (``kv_dtype="int8"`` / ``"fp8"``)**: the pool
+    stores 1-byte codes with per-(kv_head, page) fp32 amax scales
+    riding the cache beside the block table
+    (``TransformerConfig.kv_dtype`` — quantize-on-write in the model's
+    paged scatter, in-register dequant in the Pallas kernel).  The
+    allocator, refcounts, CoW forks, preemption and the trie are
+    untouched — a shared or forked page carries its scale with it —
+    and ``pool_tokens`` keeps counting TOKENS, which are now ~2×
+    (bf16) / ~4× (fp32) cheaper: the default pool converts the dense
+    slab's byte budget into quantized token capacity, and the
+    shared-aware admission gate therefore admits the reclaimed HBM as
+    occupancy.  ``kv_dtype="auto"`` adopts the (block_size, kv_dtype)
+    pair a joint :func:`~apex_tpu.ops.autotune.tune_paged_attention`
+    sweep measured best (unquantized when nothing is cached).
+
+    Numerics contract under quantization: greedy chains agree with
+    ``generate()`` within the quantized accuracy band (≥95% token
+    agreement on trained models — tests), NOT bitwise; chains remain
+    deterministic per (tokens, knobs) and co-tenant-independent.  One
+    spec-decoding nuance: write-then-attend puts draft K/V in the pool
+    before acceptance is known, so a REJECTED draft's amax legitimately
+    stays in its page's monotone running scale — spec-on and spec-off
+    quantized chains therefore agree within the band, not bitwise
+    (same bounded drift class as rescale-on-append; the rolled-back
+    CODES are overwritten next step as usual).
+
     ``block_size=0`` consults the
     :mod:`~apex_tpu.ops.autotune` table (op ``"paged_attention"``,
-    keyed on head_dim/dtype) and falls back to 16.  ``pool_tokens``
-    defaults to ``max_slots × max_seq_len`` — the dense slab's
-    footprint; shrink it to trade capacity for memory (admission
-    token-gates and preemption backstops the overcommit).
+    keyed on head_dim + the pool's STORAGE dtype) and falls back
+    to 16.  ``pool_tokens`` defaults to ``max_slots × max_seq_len`` —
+    the dense slab's footprint (converted into quantized tokens at
+    equal bytes when ``kv_dtype`` is set); shrink it to trade capacity
+    for memory (admission token-gates and preemption backstops the
+    overcommit).
     """
 
     paged = True
@@ -539,7 +567,8 @@ class PagedEngine:
                  admit_headroom: Optional[int] = None,
                  share_prefixes: bool = False,
                  spec_tokens: int = 0,
-                 spec_ngram: int = 3):
+                 spec_ngram: int = 3,
+                 kv_dtype: Optional[str] = None):
         cfg = getattr(model, "cfg", None)
         if cfg is None or not hasattr(cfg, "max_seq_len"):
             raise ValueError(
@@ -577,17 +606,52 @@ class PagedEngine:
         #: so the spec executable is traced even when the dummy context
         #: has no n-gram hit
         self._drafter = prompt_lookup_draft
+        from apex_tpu.ops import autotune
+        from apex_tpu.ops.paged_attention import (
+            kv_quant_spec, kv_store_bytes_per_token)
+        if kv_dtype == "auto":
+            # adopt the (block_size, kv_dtype) pair a joint
+            # tune_paged_attention sweep measured best — only together
+            # with block_size=0 (an explicit block size means the
+            # caller is overriding the tuner, so we don't silently
+            # flip their numerics either)
+            pair = (autotune.cached_paged_pair(
+                int(cfg.head_dim), str(jnp.dtype(cfg.dtype)))
+                if block_size == 0 else None)
+            kv_dtype = pair[1] if pair else None
+            if pair and block_size == 0:
+                block_size = pair[0]
+        store_dt, _qmax = kv_quant_spec(kv_dtype)   # validates name
+        self.kv_dtype = kv_dtype
+        #: pool storage bits per K/V element (metrics/health gauge)
+        self.kv_bits = 8 * (jnp.dtype(cfg.dtype).itemsize
+                            if store_dt is None
+                            else jnp.dtype(store_dt).itemsize)
         if block_size == 0:
-            from apex_tpu.ops import autotune
+            # per-dtype lookup: a quantized pool's measured best block
+            # size is cached under its STORAGE dtype
+            key_dt = (str(jnp.dtype(cfg.dtype)) if store_dt is None
+                      else str(jnp.dtype(store_dt)))
             block_size = autotune.cached_block_rows(
-                "paged_attention", int(cfg.head_dim),
-                str(jnp.dtype(cfg.dtype))) or 16
+                "paged_attention", int(cfg.head_dim), key_dt) or 16
         if block_size < 1:
             raise ValueError(
                 f"block_size must be >= 1, got {block_size}")
         self.block_size = int(block_size)
         if pool_tokens is None:
             pool_tokens = self.max_slots * self.max_seq_len
+            if store_dt is not None:
+                # equal-HBM default: the dense-slab byte budget
+                # (max_slots × max_seq_len tokens at the compute
+                # dtype) buys ~itemsize× the QUANTIZED tokens, scale
+                # overhead included — the reclaimed HBM becomes
+                # admitted occupancy instead of idle savings (same
+                # formula the bench traffic model counts with)
+                unq = kv_store_bytes_per_token(
+                    cfg.head_dim, self.block_size, dtype=cfg.dtype)
+                qnt = kv_store_bytes_per_token(
+                    cfg.head_dim, self.block_size, kv_dtype)
+                pool_tokens = int(pool_tokens * unq / qnt)
         # the pool bounds the largest ADMISSIBLE request
         # (validate_request rejects anything that could never fit
         # alone); the floor here only covers the warmup tenants — the
@@ -620,7 +684,7 @@ class PagedEngine:
         # never collide with a dense model's in any jit cache
         self._paged_model = type(model)(cfg=dataclasses.replace(
             cfg, kv_cache="paged", kv_block_size=self.block_size,
-            kv_pool_blocks=num_blocks))
+            kv_pool_blocks=num_blocks, kv_dtype=self.kv_dtype))
         shapes = cache_shapes(self._paged_model, self.max_slots)
         self.cache = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes)
@@ -640,8 +704,11 @@ class PagedEngine:
         def step_fn(variables, cache, state, tables, cursors, feed,
                     n_tokens, is_prefill, emit):
             # the host-authoritative block tables / cursors overwrite
-            # their cache leaves (the model never advances them)
-            cache = slot_cache.set_paged_leaves(cache, tables, cursors)
+            # their cache leaves (the model never advances them);
+            # n_tokens doubles as the quantized pool's chunk_lens so
+            # pad lanes can't pollute page scales
+            cache = slot_cache.set_paged_leaves(cache, tables, cursors,
+                                                n_tokens)
             # one ragged-batch application: prefilling rows feed their
             # chunk, decoding rows their last sampled token (+ pad)
             tok_ids = jnp.zeros_like(feed).at[:, 0].set(state.tok)
@@ -678,7 +745,8 @@ class PagedEngine:
             # positions; write-then-attend puts the drafts' K/V in the
             # pool first, and the absolute-position mask gives each
             # draft exactly its sequential context.
-            cache = slot_cache.set_paged_leaves(cache, tables, cursors)
+            cache = slot_cache.set_paged_leaves(cache, tables, cursors,
+                                                n_tokens)
             ids = feed.at[:, 0].set(state.tok)
             logits, cache = apply_decode(model, variables, cache, ids)
             # sequential rng chain: position j samples with the j-th

@@ -1130,6 +1130,12 @@ class FleetRouter:
                  if "spec_accept_rate" in h]
         if rates:
             stats["spec_accept_rate"] = sum(rates) / len(rates)
+        # narrowest KV storage width in the fleet (8 = some replica
+        # serves quantized pages); numeric for the metrics pipeline —
+        # the dtype NAMES ride health()["kv_dtypes"]
+        bits = [int(h["kv_bits"]) for h in sweep if "kv_bits" in h]
+        if bits:
+            stats["kv_bits_min"] = min(bits)
         stats.update(self.latency_summary())
         writer(writer.advance_step(),
                {f"fleet/{k}": float(v) for k, v in stats.items()})
@@ -1214,6 +1220,12 @@ class FleetRouter:
                 int(h.get("cow_forks", 0)) for h in sweep),
             "spec_accept_rate": (sum(rates) / len(rates)
                                  if rates else 0.0),
+            # distinct KV-pool storage dtypes across live replicas
+            # (sorted; "none" = an unquantized paged pool) — a mixed
+            # fleet mid-rollout legitimately reports several
+            "kv_dtypes": sorted({
+                str(h.get("kv_dtype") or "none") for h in sweep
+                if "kv_bits" in h}),
             "supervisor_error": (None if self.supervisor_error is None
                                  else repr(self.supervisor_error)),
         }

@@ -201,7 +201,12 @@ class Scheduler:
         requires free pages to cover prompt + decode headroom; the
         dense engine always says yes).  The check stays FIFO — a
         too-big head blocks the queue rather than being overtaken,
-        so admission order cannot starve large requests.
+        so admission order cannot starve large requests.  Under a
+        quantized pool (``kv_dtype="int8"``/``"fp8"``, ISSUE 8) the
+        gate needs no extra logic: the engine sizes ``pool_tokens`` in
+        QUANTIZED tokens (~2–4× more at equal HBM), so the same
+        free-page arithmetic admits the reclaimed capacity as
+        occupancy.
         """
         admitted = 0
         for slot, occupant in enumerate(self._slots):

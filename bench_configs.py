@@ -14,7 +14,9 @@ Usage:
 Legs (reference workloads per BASELINE.json):
   resnet50_o1        ResNet-50, amp O1 + FusedSGD           (configs[0])
   resnet50_syncbn    + DDP shard_map step + SyncBatchNorm   (configs[1..2])
-  bert_o1            BERT-Large, amp O1 interceptor + FusedAdam
+  bert_o1            BERT-Large, amp O1 interceptor + FusedAdam, +
+                     grad-sync bytes-on-wire model and the measured
+                     bert_o1_ddp int8-allreduce A/B child (ROADMAP 2b)
   gpt2_1p3b          GPT-2 1.3B-family single-chip proxy    (configs[3])
                      (BENCH_GPT_VARIANT: base/noselect/fused_cast —
                      the round-5 optimizer-overlap experiment)
@@ -29,6 +31,9 @@ Legs (reference workloads per BASELINE.json):
   prefix_spec_serving  CoW prefix sharing A/B at equal HBM (tokens/s,
                      TTFT, pool capacity shared vs unshared) + the
                      prompt-lookup speculative-decoding tokens/step
+  quantized_kv_serving  int8 KV pages at equal HBM: 2x slots in the
+                     same bytes (capacity >= 1.9x asserted), tokens/s
+                     + TTFT A/B vs the unquantized paged pool
   resilience_overhead  ResilientLoop + async rolling checkpoints vs
                      the bare train loop (target <2% at ckpt-every-100)
   fleet_serving      multi-replica FleetRouter tokens/s + TTFT p50/p99
@@ -1250,13 +1255,54 @@ def bench_moe_mixtral():
 
 # ----------------------------------------------------------------- BERT O1
 
+def _ddp_bytes_on_wire(n_params, replicas, *, scale_stages=2):
+    """Analytic grad-sync wire traffic per replica per step (ISSUE-8
+    satellite / ROADMAP 2b): a ring all-reduce moves
+    ``2 (n-1)/n × n_params`` elements over the wire (reduce-scatter +
+    all-gather legs), so the bytes are element-width-proportional:
+
+    - fp32: × 4 bytes;
+    - bf16/fp16 (``allreduce_dtype=jnp.bfloat16``): × 2;
+    - int8 (``allreduce_dtype="int8"``, the EQuARX-style path in
+      ``parallel/ddp.py``): × 1 — the int8 ``all_to_all``
+      reduce-scatter and int8 ``all_gather`` keep every wire transfer
+      at 1 byte/element — plus ``scale_stages`` scalar amax pmax
+      collectives (4 bytes × n each, negligible).
+
+    The measured companion row is the ``bert_o1`` DDP A/B child; the
+    quantization-error side is pinned by ``test_loss_trajectory``'s
+    exact-vs-int8 band test and ``test_parallel``'s amax/127 bound.
+    """
+    n = int(replicas)
+    frac = 2 * (n - 1) / n
+    scales = scale_stages * 4 * n
+    fp32 = frac * n_params * 4
+    int8 = frac * n_params * 1 + scales
+    return {
+        "replicas": n,
+        "grad_elements": int(n_params),
+        "wire_bytes_per_step_fp32": int(fp32),
+        "wire_bytes_per_step_bf16": int(frac * n_params * 2),
+        "wire_bytes_per_step_int8": int(int8),
+        "int8_wire_reduction_vs_fp32": round(fp32 / int8, 2),
+    }
+
+
 def bench_bert_o1():
     """BERT-Large under O1 — per-op cast interceptor (amp/o1.py clone
     mechanism + amp/lists.py tables) + FusedAdam — so O1 has a measured
     number like O2 (round-1 verdict item 5).  The model is built with
     ``dtype=None`` (modules promote with their fp32 params) and every
     MXU op is routed to bf16 by the interceptor, the reference's O1
-    semantics (fp32 masters, per-op half compute)."""
+    semantics (fp32 masters, per-op half compute).
+
+    ISSUE-8 satellite (ROADMAP 2b): the emission now carries the
+    ``_ddp_bytes_on_wire`` model for this model's grad sync (int8
+    all-reduce ≈ 4× fewer ICI bytes than fp32), and the leg
+    orchestrates a measured ``bert_o1_ddp`` child — an 8-way
+    virtual-CPU-mesh DDP A/B of ``allreduce_dtype`` None vs ``"int8"``
+    on a layer-shrunk proxy (BENCH_BERT_DDP=0 skips it; on-chip, run
+    the child leg directly on the real mesh)."""
     import jax
     import jax.numpy as jnp
 
@@ -1297,10 +1343,153 @@ def bench_bert_o1():
         new_state, finite = state.apply_gradients(grads=grads)
         return new_state, loss, finite
 
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    replicas = int(os.environ.get("BENCH_DDP_REPLICAS", "8"))
     out = _measure(state, step, (ids, positions, mlm_labels), b,
                    {"batch": b, "seq": s})
     out["metric"] = "bert_large_O1_fusedadam_samples_per_sec_per_chip"
+    # ISSUE-8 / ROADMAP 2b: what the grad sync of THIS model costs on
+    # the wire per step, fp32 vs bf16 vs the ddp.py int8 path
+    out["ddp_bytes_on_wire"] = _ddp_bytes_on_wire(n_params, replicas)
+    if os.environ.get("BENCH_BERT_DDP", "1") != "0":
+        # measured companion: 8-way virtual-CPU-mesh DDP A/B of
+        # allreduce_dtype None vs "int8" on a layer-shrunk proxy
+        out["ddp_int8_ab"] = _run_child("bert_o1_ddp", {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": None,
+            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device"
+                            "_count=8").strip(),
+        }, timeout=1500)
     _emit(out)
+
+
+def bench_bert_o1_ddp():
+    """Measured ROADMAP-2b row: the BERT O1 recipe under 8-way DDP
+    (``shard_map`` + ``all_reduce_mean_grads``), A/B'ing the exact
+    fp32 grad all-reduce against the EQuARX-style int8 one
+    (``parallel/ddp.py``).  Virtual-CPU-mesh proxy by default (the
+    layer count shrinks via BENCH_BERT_DDP_LAYERS — protocol and
+    LOSS-AGREEMENT are the artifact; on real ICI the int8 row's win
+    tracks the 4× wire-byte reduction in ``_ddp_bytes_on_wire``,
+    while CPU "wire" is memcpy so the wall ratio here only prices the
+    quantize/dequant arithmetic).  Emits samples/sec + final-loss
+    agreement + the bytes model for the measured size.
+
+    Env: BENCH_BERT_DDP_LAYERS (2), BENCH_BATCH (16 global),
+    BENCH_SEQ (128), BENCH_DDP_STEPS (8)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import amp
+    from apex_tpu import parallel as apx_parallel
+    from apex_tpu.amp import o1
+    from apex_tpu.models import BertConfig, BertModel, bert_mlm_loss_fn
+    from apex_tpu.optim import fused_adam
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        _emit({"metric": "bert_o1_ddp", "value": None,
+               "skipped": f"needs >= 2 devices, have {n_dev}"})
+        return
+    layers = int(os.environ.get("BENCH_BERT_DDP_LAYERS", "2"))
+    b = int(os.environ.get("BENCH_BATCH", "16"))
+    b -= b % n_dev                     # divisible global batch
+    b = max(b, n_dev)
+    cfg = BertConfig.bert_large(remat=True, dtype=None,
+                                scan_layers=False, num_layers=layers)
+    model = BertModel(cfg)
+    s = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_seq_len, 128))))
+    p = min(max(8, int(0.15 * s / 8 + 0.5) * 8), s)
+    steps = int(os.environ.get("BENCH_DDP_STEPS", "8"))
+
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (b, s), 0, cfg.vocab_size)
+    positions = jnp.argsort(jax.random.uniform(rng, (b, s)),
+                            axis=-1)[:, :p]
+    mlm_labels = jnp.take_along_axis(ids, positions, axis=1)
+
+    def apply_fn(params, ids, **kw):
+        with o1.o1_intercept(jnp.bfloat16):
+            return model.apply(params, ids, **kw)
+
+    init = model.init(jax.random.PRNGKey(0), ids[:2])
+    n_params = sum(x.size for x in jax.tree.leaves(init))
+    # raw mesh, NOT registered with core.mesh: the step is fully
+    # manual inside shard_map, so maybe_constrain stays a no-op
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:n_dev]),
+                             ("data",))
+
+    def run(allreduce_dtype):
+        # private param copy: the donated step consumes the state's
+        # buffers, and both A/B runs must start from the same init
+        state = amp.initialize(apply_fn,
+                               jax.tree.map(jnp.copy, init),
+                               fused_adam(1e-4), opt_level="O1")
+
+        def dp_step(state, ids, positions, mlm_labels):
+            def loss_fn(p):
+                logits, _ = state.apply_fn(
+                    p, ids, mlm_positions=positions,
+                    deterministic=True)
+                loss = bert_mlm_loss_fn(
+                    logits.astype(jnp.float32), mlm_labels)
+                return state.scale_loss(loss), loss
+
+            grads, loss = jax.grad(
+                loss_fn, has_aux=True)(state.compute_params())
+            grads = apx_parallel.all_reduce_mean_grads(
+                grads, "data", allreduce_dtype=allreduce_dtype)
+            new_state, finite = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data"), finite
+
+        step = jax.jit(jax.shard_map(
+            dp_step, mesh=mesh,
+            in_specs=(P(), P("data"), P("data"), P("data")),
+            out_specs=(P(), P(), P()), check_vma=False),
+            donate_argnums=(0,))
+        state, loss, _ = step(state, ids, positions, mlm_labels)
+        bench._sync(loss)              # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss, finite = step(state, ids, positions,
+                                       mlm_labels)
+        bench._sync(loss)
+        dt = (time.perf_counter() - t0) / steps
+        return {
+            "allreduce_dtype": str(allreduce_dtype or "fp32"),
+            "samples_per_sec": round(b / dt, 2),
+            "step_ms": round(dt * 1e3, 2),
+            "final_loss": round(float(loss), 5),
+            "loss_finite": bool(finite),
+        }
+
+    exact = run(None)
+    int8 = run("int8")
+    _emit({
+        "metric": "bert_o1_ddp_int8_allreduce_samples_per_sec",
+        "value": int8["samples_per_sec"],
+        "unit": "samples/sec (CPU-mesh proxy)",
+        "replicas": n_dev, "global_batch": b, "seq": s,
+        "num_layers": layers, "num_params": int(n_params),
+        "rows": {"fp32_allreduce": exact, "int8_allreduce": int8},
+        "sps_vs_fp32_allreduce": round(
+            int8["samples_per_sec"]
+            / max(exact["samples_per_sec"], 1e-9), 3),
+        "final_loss_delta": round(
+            abs(int8["final_loss"] - exact["final_loss"]), 5),
+        "ddp_bytes_on_wire": _ddp_bytes_on_wire(n_params, n_dev),
+        "note": ("measured ROADMAP-2b row: wire bytes drop 4x (model "
+                 "above; genuine int8 all_to_all/all_gather traffic), "
+                 "loss trajectory agreement is gated by "
+                 "test_loss_trajectory's exact-vs-int8 band test; the "
+                 "CPU wall ratio prices quantize arithmetic, not ICI "
+                 "— the on-chip win follows the bytes model"),
+    })
 
 
 # ----------------------------------------------------------------- llama 1B
@@ -1664,7 +1853,7 @@ def _long_context_single():
 def _serving_traffic_model(*, num_layers, kv_heads, head_dim,
                            max_seq_len, live_tokens, slots,
                            block_size, dtype_bytes=2,
-                           shared_prefix_tokens=0):
+                           shared_prefix_tokens=0, kv_dtype=None):
     """Analytic per-step KV-cache traffic of the serving decode step —
     the measured defect behind the ISSUE-5 paged tentpole, in bytes:
 
@@ -1696,6 +1885,17 @@ def _serving_traffic_model(*, num_layers, kv_heads, head_dim,
     prefix each step — sharing is an HBM-capacity lever, not a
     bandwidth one.
 
+    With ``kv_dtype`` (``"int8"``/``"fp8"``, ISSUE 8) the paged pool
+    stores 1-byte codes plus one fp32 amax scale per (kv_head, page)
+    per side per layer.  The model then also reports the quantized
+    bytes/token (scale overhead amortized over ``block_size``), the
+    pool capacity in TOKENS the dense slab's byte budget buys at the
+    quantized width (``paged_pool_tokens_at_equal_hbm`` — the
+    admitted-occupancy lever; ≥1.9× at int8 from bf16, ~3.9× from
+    fp32), and the per-step quantized read bytes INCLUDING the scale
+    traffic (one 4-byte scalar per page per side — the kernel DMAs it
+    through the same block-table prefetch).
+
     Both counts are K+V (×2) across all layers; the param stream
     (identical for both engines) is excluded — this model isolates the
     cache term the tentpole changes.
@@ -1708,7 +1908,37 @@ def _serving_traffic_model(*, num_layers, kv_heads, head_dim,
     private_pages = pages(live_tokens - shared_pages * block_size)
     unshared_pool = slots * live_pages * block_size
     shared_pool = (shared_pages + slots * private_pages) * block_size
+    quant = {}
+    if kv_dtype is not None:
+        from apex_tpu.ops.paged_attention import (
+            kv_quant_spec, kv_store_bytes_per_token)
+
+        import jax.numpy as jnp
+
+        store_dt, _ = kv_quant_spec(kv_dtype)   # validates the name
+        store_bytes = jnp.dtype(store_dt).itemsize
+        # per-token quantized storage, scale overhead amortized: the
+        # shared per-(kv_head, layer) formula (2 sides × head_dim
+        # codes + 2 fp32 scales per page) × kv_heads × layers — the
+        # SAME arithmetic PagedEngine's equal-HBM default admits with
+        scale_per_page = 2 * kv_heads * 4 * num_layers
+        q_tok = (kv_heads * num_layers
+                 * kv_store_bytes_per_token(head_dim, block_size,
+                                            kv_dtype))
+        dense_bytes = slots * max_seq_len * per_tok
+        quant = {
+            "kv_dtype": str(kv_dtype),
+            "kv_store_bytes_per_token_quantized": round(q_tok, 3),
+            "kv_store_bytes_per_token_unquantized": int(per_tok),
+            "paged_pool_tokens_at_equal_hbm": int(dense_bytes / q_tok),
+            "quantized_capacity_multiplier": round(per_tok / q_tok, 3),
+            "paged_kv_read_bytes_per_step_quantized": int(
+                slots * live_pages
+                * (block_size * 2 * kv_heads * head_dim * store_bytes
+                   * num_layers + scale_per_page)),
+        }
     return {
+        **quant,
         "dense_kv_read_bytes_per_step":
             int(slots * max_seq_len * per_tok),
         "paged_kv_read_bytes_per_step":
@@ -2164,6 +2394,131 @@ def bench_prefix_spec_serving():
                  "measured accept rate; the CPU proxy's wall ratio is "
                  "compute-bound (verify width is linear cost there) "
                  "and reported only for honesty"),
+    })
+
+
+def bench_quantized_kv_serving():
+    """Quantized KV pages scoreboard (ISSUE 8): equal-HBM A/B of the
+    unquantized paged pool vs an ``kv_dtype="int8"`` pool holding 2×
+    the slots in the SAME byte budget, tiny-GPT proxy (CPU smoke — the
+    protocol and the RATIOS are the artifact, like
+    ``prefix_spec_serving``).
+
+    Protocol: a wave of ``2 × quantized slots`` independent requests
+    hits both servers.  The unquantized pool fits only
+    ``pool_bytes / (fp32 K+V bytes/token)`` tokens, the token-budget
+    admission gate serializes the wave behind it; the int8 pool's same
+    bytes hold ~3.9× the tokens (scales included — fp32 compute proxy;
+    2× from bf16), so 2× the slots admit concurrently and tokens/s
+    tracks admitted occupancy exactly as the ISSUE-5 occupancy sweep
+    measured (2× slots → 2.25× tokens/s at equal HBM on-chip; the CPU
+    wall ratio reported here is compute-bound and understates it).
+    The smoke ASSERTS the capacity side — ≥1.9× pool tokens at equal
+    HBM from the extended traffic model AND from the engines' actual
+    pool sizes — and reports tokens/s + TTFT p50/p99 for both rows.
+
+    Env: BENCH_QKV_SLOTS (3), BENCH_QKV_PROMPT (24), BENCH_QKV_TOKENS
+    (16), BENCH_QKV_BLOCK (8)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.ops.paged_attention import kv_store_bytes_per_token
+    from apex_tpu.serving import InferenceServer
+
+    slots = int(os.environ.get("BENCH_QKV_SLOTS", "3"))
+    P = int(os.environ.get("BENCH_QKV_PROMPT", "24"))
+    N = int(os.environ.get("BENCH_QKV_TOKENS", "16"))
+    block = int(os.environ.get("BENCH_QKV_BLOCK", "8"))
+
+    cfg = GPTConfig.tiny(position_embedding="learned",
+                         scan_layers=True)
+    if P + N + 2 > cfg.max_seq_len:
+        raise ValueError("BENCH_QKV_PROMPT+TOKENS exceeds the proxy's "
+                         f"max_seq_len ({cfg.max_seq_len})")
+    model = GPTModel(cfg)
+    params = {"params": model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 4), jnp.int32))["params"]}
+    rng = np.random.default_rng(0)
+
+    # the shared byte budget: an unquantized pool that fits the base
+    # slot count's working set (prompt + budget + page slack)
+    per_tenant = P + N + 2 * block
+    pool_base = slots * per_tenant
+    # K+V bytes per token per (kv_head, layer) — the common factor
+    # cancels in the ratio; the shared formula is the one
+    # PagedEngine's equal-HBM default admits with
+    unq_tok = kv_store_bytes_per_token(cfg.head_dim, block,
+                                       dtype=cfg.dtype)
+    q_tok = kv_store_bytes_per_token(cfg.head_dim, block, "int8")
+    pool_quant = int(pool_base * unq_tok / q_tok)
+    q_slots = 2 * slots
+    wave = 2 * q_slots
+    prompts = [rng.integers(0, cfg.vocab_size, size=(P,))
+               .astype(np.int32) for _ in range(wave)]
+
+    def run_wave(kv_dtype, max_slots, pool_tokens):
+        server = InferenceServer(
+            model, params, max_slots=max_slots, kv_cache="paged",
+            block_size=block, pool_tokens=pool_tokens,
+            prefill_chunk=8, kv_dtype=kv_dtype)
+        with server:
+            t0 = time.perf_counter()
+            handles = [server.submit(p, max_new_tokens=N, seed=i)
+                       for i, p in enumerate(prompts)]
+            tokens = sum(len(h.result(timeout=600)) for h in handles)
+            wall = time.perf_counter() - t0
+            lat = server.latency_summary()
+            assert server.engine.blocks_in_use == 0
+            pool = server.engine.pool_tokens
+        return {
+            "kv_dtype": kv_dtype or "none",
+            "slots": max_slots,
+            "pool_tokens": pool,
+            "tokens_per_sec": round(tokens / wall, 1),
+            "wall_s": round(wall, 3),
+            "ttft_p50_ms": round(lat.get("ttft_p50_s", 0.0) * 1e3, 1),
+            "ttft_p99_ms": round(lat.get("ttft_p99_s", 0.0) * 1e3, 1),
+        }
+
+    base = run_wave(None, slots, pool_base)
+    quant = run_wave("int8", q_slots, pool_quant)
+    capacity_mult = quant["pool_tokens"] / base["pool_tokens"]
+    assert capacity_mult >= 1.9, (
+        f"equal-HBM int8 pool holds only {capacity_mult:.2f}x the "
+        "tokens (acceptance: >= 1.9x, scales included)")
+    tm = _serving_traffic_model(
+        num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
+        head_dim=cfg.head_dim, max_seq_len=cfg.max_seq_len,
+        live_tokens=P + N, slots=q_slots, block_size=block,
+        dtype_bytes=comp_bytes, kv_dtype="int8")
+    assert tm["quantized_capacity_multiplier"] >= 1.9
+    _emit({
+        "metric": "quantized_kv_serving_int8_tokens_per_sec",
+        "value": quant["tokens_per_sec"],
+        "unit": "tokens/sec (CPU-proxy smoke)",
+        "prompt": P, "budget": N, "block_size": block,
+        "hbm_budget": f"= unquantized pool at {slots} slots "
+                      f"({base['pool_tokens']} tokens)",
+        "rows": {"unquantized": base, "int8_2x_slots": quant},
+        "pool_capacity_multiplier_at_equal_hbm":
+            round(capacity_mult, 2),
+        "tps_vs_unquantized": round(
+            quant["tokens_per_sec"]
+            / max(base["tokens_per_sec"], 1e-9), 2),
+        "analytic_kv_traffic": tm,
+        "note": ("equal-HBM A/B: the int8 pool admits 2x the slots in "
+                 "the same bytes; on-chip the occupancy-sweep protocol "
+                 "(serving_decode: 2x slots -> 2.25x tokens/s) "
+                 "converts that into >= 1.5x sustained tokens/s — the "
+                 "CPU wall ratio here is compute-bound (dequant is "
+                 "arithmetic, not bandwidth, on CPU) and reported for "
+                 "honesty; the asserted artifact is the capacity side, "
+                 "scales included"),
     })
 
 
@@ -2716,6 +3071,7 @@ LEGS = {
     "resnet50_o1": bench_resnet50_o1,
     "resnet50_syncbn": bench_resnet50_syncbn,
     "bert_o1": bench_bert_o1,
+    "bert_o1_ddp": bench_bert_o1_ddp,
     "gpt2_1p3b": bench_gpt2_1p3b,
     "gpt2_tp8_full_step": bench_gpt2_tp8_full_step,
     "gpt2_3d_full_step": bench_gpt2_3d_full_step,
@@ -2725,6 +3081,7 @@ LEGS = {
     "decode": bench_decode,
     "serving_decode": bench_serving_decode,
     "prefix_spec_serving": bench_prefix_spec_serving,
+    "quantized_kv_serving": bench_quantized_kv_serving,
     "resilience_overhead": bench_resilience_overhead,
     "fleet_serving": bench_fleet_serving,
     "vit_huge_lamb": bench_vit_huge_lamb,

@@ -16,9 +16,15 @@ replica servers, least-loaded health-gated routing by the
 blocks-occupancy gauge, and per-replica metrics aggregated into one
 fleet view (docs/fleet.md).
 
+With ``--kv-dtype int8`` (or ``fp8`` where the jax build has
+``float8_e4m3fn``) the server runs the PAGED datapath with a quantized
+KV pool: 1-byte pages + per-page amax scales, ~2–4× the token capacity
+at equal HBM admitted as occupancy (docs/serving.md).
+
 Run (CPU works):
     python examples/serving_demo.py [--max-slots 2] [--requests 5]
     python examples/serving_demo.py --replicas 3 --requests 8
+    python examples/serving_demo.py --kv-dtype int8 --requests 5
 """
 
 from __future__ import annotations
@@ -36,6 +42,11 @@ def main():
                     help="N > 1 serves through a FleetRouter over N "
                          "paged replica servers")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=("int8", "fp8"),
+                    help="quantize the paged KV pool (1-byte pages + "
+                         "per-page amax scales; implies the paged "
+                         "datapath on the single-server run)")
     args = ap.parse_args()
 
     import jax
@@ -93,7 +104,7 @@ def main():
                 model, params, max_slots=args.max_slots,
                 kv_cache="paged", block_size=8, prefill_chunk=4,
                 pool_tokens=args.max_slots * cfg.max_seq_len,
-                metrics_interval=4)
+                kv_dtype=args.kv_dtype, metrics_interval=4)
 
         router = FleetRouter(factory, replicas=args.replicas,
                              probe_interval=0.1, metrics=metrics,
@@ -110,12 +121,25 @@ def main():
               f"{args.replicas} replicas")
         return
 
-    server = InferenceServer(
-        model, params, max_slots=args.max_slots,
-        prompt_buckets=(4, 8, 16), metrics=metrics,
-        metrics_interval=4)
+    if args.kv_dtype is not None:
+        # quantized pools live in the paged datapath (a dense server
+        # rejects kv_dtype loudly)
+        server = InferenceServer(
+            model, params, max_slots=args.max_slots,
+            kv_cache="paged", block_size=8, prefill_chunk=4,
+            kv_dtype=args.kv_dtype, metrics=metrics,
+            metrics_interval=4)
+    else:
+        server = InferenceServer(
+            model, params, max_slots=args.max_slots,
+            prompt_buckets=(4, 8, 16), metrics=metrics,
+            metrics_interval=4)
     with server:
         handles = submit_and_stream(server)
+        if args.kv_dtype is not None:
+            h = server.health()
+            print(f"kv: dtype={h['kv_dtype']} bits={h['kv_bits']} "
+                  f"pool_tokens={server.engine.pool_tokens}")
     print(f"done: {len(handles)} requests, "
           f"{server.tokens_emitted} tokens in {server.steps} steps")
 

@@ -20,6 +20,9 @@ Two acceptance soaks for the resilience layer (docs/resilience.md):
   streams token-identical to an uninterrupted ``generate()``,
   survivors' paged pools back to ``blocks_in_use == 0``, and every
   replica's trace budget still exactly 4 executables × 1 trace.
+- **quantized paged soak** (ISSUE 8): the sharing+spec paged soak
+  with ``kv_dtype="int8"`` — zero lost/hung, ``blocks_in_use == 0``
+  (per-page scales freed with their pages), budgets exactly 5 × 1.
 
 CI runs these in the dedicated ``chaos-smoke`` job (small configs,
 CPU).  They carry ``slow`` too: the tier-1 ``-m 'not slow'`` gate
@@ -443,6 +446,87 @@ class TestPagedServingChaosSoak:
         # programs at the exact warmed budget — 5 executables, 1 each
         assert server.engine.spec_proposed > 0
         assert after == before, "sharing+spec chaos soak retraced"
+        assert server.engine.trace_counts == {
+            "decode_step": 1, "prefill_step": 1, "spec_step": 1,
+            "admit": 1, "release": 1}
+
+    def test_soak_quantized_sharing_and_spec_no_leaks(self):
+        """ISSUE-8 chaos satellite: the sharing+spec soak with
+        ``kv_dtype="int8"`` on — transient step/admit faults, deadline
+        expiries and pool-pressure preempts over a QUANTIZED pool.
+        Zero lost/hung; ``blocks_in_use == 0`` exactly at the end (a
+        page's scale lives at its pool index and is reset at the next
+        tenant's first write, so freeing the page IS freeing the scale
+        — a refcount miscount would strand both); trace budget exactly
+        the warmed 5 × 1 (scale maintenance rides inside the existing
+        executables).  Chains here are quantized (within the accuracy
+        band of ``generate()``, not bitwise — the parity-to-band claim
+        is pinned by test_paged_serving's trained-proxy test); what
+        this soak pins is accounting + trace discipline under fire."""
+        model, params = self._tiny()
+        server = InferenceServer(model, params, max_slots=3,
+                                 kv_cache="paged", block_size=8,
+                                 pool_tokens=160, prefill_chunk=4,
+                                 admit_headroom=0, share_prefixes=True,
+                                 spec_tokens=3, kv_dtype="int8")
+        plan = FaultPlan([
+            FaultSpec(site="serving.step", kind="transient", every=6,
+                      times=3),
+            FaultSpec(site="serving.admit", kind="transient", step=4,
+                      times=1),
+        ])
+        rng = np.random.default_rng(83)
+        pref = rng.integers(0, model.cfg.vocab_size,
+                            size=(16,)).astype(np.int32)
+        cases = []
+        for i in range(12):
+            if i % 2 == 0:           # hot shared prompt, lookup-friendly
+                prompt = np.concatenate([pref, rng.integers(
+                    0, model.cfg.vocab_size,
+                    size=(1 + i // 2,)).astype(np.int32)])
+            else:                    # cold random traffic
+                prompt = rng.integers(0, model.cfg.vocab_size,
+                                      size=(3 + i,)).astype(np.int32)
+            t, k, p = [(0.0, None, None), (0.8, 20, None),
+                       (1.2, 5, 0.9)][i % 3]
+            cases.append((prompt, 4 + i % 8, t, k, p, i))
+        with active(plan):
+            with server:
+                before = tracecheck.trace_event_count()
+                handles = [
+                    server.submit(p, max_new_tokens=n, temperature=t,
+                                  top_k=k, top_p=tp, seed=s)
+                    for p, n, t, k, tp, s in cases]
+                doomed = [server.submit(
+                    np.concatenate([pref, np.zeros(2, np.int32)]),
+                    max_new_tokens=5, deadline=1e-4)
+                    for _ in range(2)]
+                completed, failed, hung = 0, 0, 0
+                for h in handles + doomed:
+                    try:
+                        toks = h.result(timeout=300)
+                        completed += 1
+                        assert 1 <= len(toks)
+                    except RequestFailed:
+                        failed += 1
+                    except TimeoutError:
+                        hung += 1
+                health = server.health()
+                after = tracecheck.trace_event_count()
+
+        assert hung == 0
+        assert completed + failed == len(cases) + len(doomed)
+        assert completed >= len(cases) - 2
+        assert health["status"] == "serving", health
+        assert server.error is None
+        assert health["kv_dtype"] == "int8"
+        assert health["kv_bits"] == 8
+        # every page (and with it, its scale slot) came home
+        assert health["blocks_in_use"] == 0
+        assert server.engine.blocks_in_use == 0
+        assert server.engine.shared_blocks == 0
+        assert server.engine.spec_proposed > 0
+        assert after == before, "quantized chaos soak retraced"
         assert server.engine.trace_counts == {
             "decode_step": 1, "prefill_step": 1, "spec_step": 1,
             "admit": 1, "release": 1}

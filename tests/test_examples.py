@@ -137,6 +137,19 @@ class TestServingDemoExample:
         assert "metrics step=" in r.stdout, r.stdout[-2000:]
 
     @pytest.mark.slow
+    def test_kv_dtype_serves_quantized_paged(self):
+        # [slow: a second serving subprocess warming the paged server
+        # ≈ 25s; the quantized datapath itself is tier-1-covered by
+        # test_paged_serving.py::TestQuantizedKV]
+        r = _run_example("examples/serving_demo.py",
+                         ["--requests", "5", "--max-slots", "2",
+                          "--kv-dtype", "int8"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert r.stdout.count("req ") == 5, r.stdout[-2000:]
+        assert "kv: dtype=int8 bits=8" in r.stdout, r.stdout[-2000:]
+        assert "done: 5 requests" in r.stdout, r.stdout[-2000:]
+
+    @pytest.mark.slow
     def test_replicas_path_routes_through_fleet(self):
         # [slow: a second serving subprocess warming 2 paged replicas
         # ≈ 25s; the fleet router itself is tier-1-covered by

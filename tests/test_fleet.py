@@ -54,7 +54,7 @@ class FakeServer:
     the real tap plumbing."""
 
     def __init__(self, *, blocks=(0, 16), queue_depth=0, occupancy=0.0,
-                 reject=None, prefix_hit=0):
+                 reject=None, prefix_hit=0, kv_dtype=None, kv_bits=None):
         self.calls = []
         self.live = {}                  # key -> (prompt, kwargs, tap)
         self._keys = itertools.count()
@@ -63,6 +63,8 @@ class FakeServer:
         self.occupancy = occupancy
         self.reject = reject            # exception class raised on submit
         self.prefix_hit = prefix_hit    # scripted trie hit (affinity)
+        self.kv_dtype = kv_dtype        # scripted pool storage dtype
+        self.kv_bits = kv_bits          # ... and width (None = dense)
         self.running = False
         self.draining = False
         self.metrics = None
@@ -91,6 +93,9 @@ class FakeServer:
         if self.blocks_total:
             out["blocks_in_use"] = self.blocks_in_use
             out["blocks_total"] = self.blocks_total
+        if self.kv_bits is not None:
+            out["kv_dtype"] = self.kv_dtype
+            out["kv_bits"] = self.kv_bits
         return out
 
     def latency_summary(self):
@@ -629,3 +634,26 @@ class TestFleetHealth:
         assert health["completed"] == 1 and health["in_flight"] == 0
         router.shutdown()
         assert not router.health()["ready"]
+
+    def test_kv_dtype_merged_view(self):
+        """ISSUE-8 fleet view: health() lists the DISTINCT pool
+        storage dtypes across live replicas (a mixed fleet mid-rollout
+        legitimately reports several; 'none' = unquantized paged), and
+        the metrics row carries the narrowest width as
+        fleet/kv_bits_min."""
+        from apex_tpu.utils import MetricsWriter
+
+        a = FakeServer(blocks=(0, 16), kv_dtype="int8", kv_bits=8)
+        b = FakeServer(blocks=(0, 16), kv_dtype=None, kv_bits=32)
+        c = FakeServer()                     # dense: no kv fields
+        rows = []
+        writer = MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+        router = _router([a, b, c], metrics=writer)
+        health = router.health()
+        assert health["kv_dtypes"] == ["int8", "none"]
+        router._emit_metrics()
+        merged = {}
+        for _, m in rows:
+            merged.update(m)
+        assert merged.get("fleet/kv_bits_min") == 8.0
+        router.shutdown(wait=False)

@@ -27,7 +27,18 @@ Correctness contracts under test:
 - speculative decoding (ISSUE 7): the prompt-lookup drafter, the
   one-application K-token verify, acceptance-invariant greedy AND
   sampled chains, the accept-rate gauge, and the 5-executable /
-  zero-retrace budget with drafting on.
+  zero-retrace budget with drafting on;
+- quantized KV pages (ISSUE 8): ``kv_dtype="int8"``/``"fp8"`` pool
+  storage with per-(kv_head, page) amax scales — the ≥1.9× equal-HBM
+  capacity default, scale reset on page reuse (deterministic replay on
+  a dirty pool), sharing/CoW/spec riding quantized pages
+  token-identically to an unshared quantized run, the 5×1 trace budget
+  with quantization on, kv_dtype/kv_bits in health()+metrics, the
+  "auto" pair pickup from the autotune table, and (slow tier) ≥95%
+  greedy token agreement vs ``generate()`` on a trained proxy.
+  ``kv_dtype=None`` byte-identity is pinned by this whole module: every
+  other test here runs the default unquantized pool through the same
+  code path.
 """
 
 import numpy as np
@@ -473,6 +484,34 @@ class TestTrafficModel:
         assert small["paged_kv_read_bytes_per_step"] \
             < small["dense_kv_read_bytes_per_step"]
 
+    def test_quantized_kv_capacity_and_read_bytes(self):
+        """ISSUE-8 keys: at int8 the same HBM holds >= 1.9x the tokens
+        (scales INCLUDED — from 2-byte storage it lands just under
+        2.0x, the scale tax), per-step quantized reads count the scale
+        traffic, and kv_dtype=None leaves the dict unchanged."""
+        import bench_configs as bc
+
+        cfg = dict(num_layers=4, kv_heads=2, head_dim=64,
+                   max_seq_len=2048, dtype_bytes=2, slots=8,
+                   block_size=16, live_tokens=256)
+        plain = bc._serving_traffic_model(**cfg)
+        quant = bc._serving_traffic_model(**cfg, kv_dtype="int8")
+        assert "kv_dtype" not in plain
+        mult = quant["quantized_capacity_multiplier"]
+        assert 1.9 <= mult < 2.0       # bf16 -> int8, scale tax real
+        assert quant["paged_pool_tokens_at_equal_hbm"] \
+            >= 1.9 * quant["paged_pool_tokens"]
+        # quantized reads: half the page bytes plus the scale scalars
+        assert quant["paged_kv_read_bytes_per_step_quantized"] \
+            > quant["paged_kv_read_bytes_per_step"] // 2
+        assert quant["paged_kv_read_bytes_per_step_quantized"] \
+            < quant["paged_kv_read_bytes_per_step"]
+        # unchanged keys stay byte-identical with the flag off
+        assert {k: v for k, v in quant.items()
+                if k in plain} == plain
+        with pytest.raises(ValueError, match="kv_dtype"):
+            bc._serving_traffic_model(**cfg, kv_dtype="int4")
+
 
 class TestRefcountedAllocator:
     def test_incref_defers_free_and_counts_sharing(self):
@@ -858,3 +897,360 @@ class TestSpeculativeDecoding:
             merged.update(m)
         assert {"shared_blocks", "cow_forks",
                 "spec_accept_rate"} <= set(merged)
+
+
+class TestQuantizedKV:
+    """ISSUE 8: int8/fp8 paged KV pool with per-(kv_head, page) amax
+    scales riding the cache beside the block table."""
+
+    def test_kv_dtype_validation_is_loud(self, gpt):
+        model, params = gpt
+        import dataclasses
+
+        from apex_tpu.models import GPTConfig
+
+        with pytest.raises(ValueError, match="paged"):
+            dataclasses.replace(model.cfg, kv_dtype="int8")
+        with pytest.raises(ValueError, match="kv_dtype"):
+            dataclasses.replace(
+                model.cfg, kv_cache="paged", kv_block_size=8,
+                kv_pool_blocks=4, kv_dtype="int4")
+        with pytest.raises(ValueError, match="paged"):
+            InferenceServer(model, params, kv_dtype="int8")
+        with pytest.raises(ValueError, match="kv_dtype"):
+            PagedEngine(model, params, kv_dtype="int4")
+
+    def test_equal_hbm_default_pool_capacity_at_least_1p9x(self, gpt):
+        """The quantized engine's default pool converts the dense
+        slab's byte budget into quantized tokens, SCALES INCLUDED:
+        ≥1.9× the unquantized token capacity at int8 (~3.9× here —
+        the fp32 test model stores 4-byte K/V unquantized)."""
+        model, params = gpt
+        base = PagedEngine(model, params, max_slots=2, block_size=8)
+        quant = PagedEngine(model, params, max_slots=2, block_size=8,
+                            kv_dtype="int8")
+        assert quant.kv_bits == 8 and base.kv_bits == 32
+        ratio = quant.pool_tokens / base.pool_tokens
+        assert ratio >= 1.9, ratio
+        # ... and the scale overhead was actually charged: the pool is
+        # strictly smaller than a scale-free itemsize conversion
+        assert quant.pool_tokens < base.pool_tokens * 4
+        # an EXPLICIT pool_tokens is never silently rescaled
+        pinned = PagedEngine(model, params, max_slots=2, block_size=8,
+                             pool_tokens=64, kv_dtype="int8")
+        assert pinned.pool_tokens == 64
+
+    def test_page_reuse_resets_scales_deterministically(self, gpt):
+        """Replay the same request on a DIRTY pool (pages + scales
+        left by a released tenant): the first write of each reused
+        page resets its scale, so the second chain is token-identical
+        to the first — stale scales never leak into fresh tenants."""
+        model, params = gpt
+        rng = np.random.default_rng(71)
+        engine = PagedEngine(model, params, max_slots=2, block_size=8,
+                             prefill_chunk=4, kv_dtype="int8")
+        sched = Scheduler(engine)
+        prompts = [rng.integers(0, model.cfg.vocab_size,
+                                size=(L,)).astype(np.int32)
+                   for L in (7, 12)]
+
+        def wave():
+            reqs = [sched.submit(Request(prompt=p, max_new_tokens=6))
+                    for p in prompts]
+            sched.drain()
+            assert engine.blocks_in_use == 0
+            return [list(r.tokens) for r in reqs]
+
+        first = wave()
+        assert wave() == first
+
+    def test_pad_lane_content_never_touches_page_scales(self, gpt):
+        """Mixed-step pad lanes (>= the row's chunk_lens) route to the
+        null page: live page scales AND codes are bitwise invariant to
+        pad content.  Without the routing, a pad lane's K/V amax would
+        scatter-MAX into the row's current page scale and stick
+        forever (the running amax is monotone), so a tenant's page
+        codes would depend on what garbage happened to ride beside it
+        — breaking the scales-are-a-pure-function-of-the-row's-tokens
+        invariant that shared/CoW pages rely on."""
+        model, params = gpt
+        import dataclasses
+
+        from apex_tpu.models.generate import apply_decode, cache_shapes
+        cfg = dataclasses.replace(
+            model.cfg, kv_cache="paged", kv_block_size=8,
+            kv_pool_blocks=6, kv_dtype="int8")
+        paged = type(model)(cfg=cfg)
+        shapes = cache_shapes(paged, 1)
+        base = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            shapes)
+        mb = slot_cache.blocks_for(cfg.max_seq_len, 8)
+        tables = np.zeros((1, mb), np.int32)
+        tables[0, 0] = 1                 # one live page for the row
+
+        def leaves(tree, name):
+            return [np.asarray(leaf) for path, leaf
+                    in jax.tree_util.tree_flatten_with_path(tree)[0]
+                    if slot_cache._leaf_name(path) == name]
+
+        def run(pad_id):
+            # 2 real tokens + 2 pad lanes of width-4 mixed step
+            ids = np.full((1, 4), pad_id, np.int32)
+            ids[0, :2] = (3, 5)
+            cache = slot_cache.set_paged_leaves(
+                base, tables, np.zeros((1,), np.int32),
+                np.array([2], np.int32))
+            logits, cache = apply_decode(
+                paged, params, cache, jnp.asarray(ids))
+            return np.asarray(logits[:, :2]), cache
+
+        ref_logits, ref_cache = run(0)
+        got_logits, got_cache = run(int(model.cfg.vocab_size) - 1)
+        np.testing.assert_array_equal(got_logits, ref_logits)
+        for name in ("key_scales", "value_scales"):
+            for ref, got in zip(leaves(ref_cache, name),
+                                leaves(got_cache, name)):
+                # every page but the null page (0) is bitwise pinned
+                np.testing.assert_array_equal(got[..., 1:],
+                                              ref[..., 1:])
+        for name in ("paged_key", "paged_value"):
+            for ref, got in zip(leaves(ref_cache, name),
+                                leaves(got_cache, name)):
+                np.testing.assert_array_equal(got[..., 1:, :, :],
+                                              ref[..., 1:, :, :])
+
+    def test_sharing_cow_and_spec_ride_quantized_pages(self, gpt):
+        """Shared prefix pages, a CoW fork, and drafted steps on the
+        int8 pool: a tenant reading pages another tenant wrote must
+        emit the SAME chain as running alone on a fresh quantized
+        engine with the same knobs (prefill chunking and drafting are
+        deterministic per row, so page codes and scales are a pure
+        function of the row's own token/draft history — co-tenants
+        never touch them), and the pool drains with refcounts
+        balanced.  The solo twin keeps spec ON: under quantization a
+        REJECTED draft's amax legitimately stays in the page's
+        monotone running scale (write-then-attend writes draft K/V
+        before acceptance is known), so spec-on and spec-off quantized
+        chains agree only within the accuracy band, not bitwise — the
+        documented drift class of rescale-on-append."""
+        model, params = gpt
+        rng = np.random.default_rng(73)
+        pref = rng.integers(0, model.cfg.vocab_size,
+                            size=(16,)).astype(np.int32)
+        pa = np.concatenate([pref, rng.integers(
+            0, model.cfg.vocab_size, size=(3,)).astype(np.int32)])
+        pb = np.concatenate([pref, rng.integers(
+            0, model.cfg.vocab_size, size=(5,)).astype(np.int32)])
+
+        solo_eng = PagedEngine(model, params, max_slots=1,
+                               block_size=8, prefill_chunk=4,
+                               spec_tokens=3, kv_dtype="int8")
+        solo_sched = Scheduler(solo_eng)
+
+        def solo(prompt, n):
+            # ONE reused engine (compile budget): the pool drains
+            # between waves and scale reset handles the dirty pages
+            r = solo_sched.submit(Request(prompt=prompt,
+                                          max_new_tokens=n))
+            solo_sched.drain()
+            assert solo_eng.blocks_in_use == 0
+            return list(r.tokens)
+
+        engine = PagedEngine(model, params, max_slots=2, block_size=8,
+                             prefill_chunk=4, share_prefixes=True,
+                             spec_tokens=3, kv_dtype="int8")
+        sched = Scheduler(engine)
+        # budget large enough that A (multi-token spec emissions) is
+        # still LIVE when B arrives — a freed tenant's last-ref pages
+        # leave the trie with it
+        ra = sched.submit(Request(prompt=pa, max_new_tokens=14))
+        for _ in range(6):               # A past prefill, still live
+            sched.run_step()
+        assert engine.trie_blocks == 2
+        rb = sched.submit(Request(prompt=pb, max_new_tokens=6))
+        sched.run_step()
+        assert engine.shared_blocks == 2     # B mapped A's prefix
+        # whole-prompt trie hit (16 = exactly 2 pages): CoW-forks the
+        # last matched block on the quantized pool
+        rc = sched.submit(Request(prompt=pref.copy(),
+                                  max_new_tokens=6))
+        sched.drain()
+        assert engine.cow_forks >= 1
+        assert list(ra.tokens) == solo(pa, 14)
+        assert list(rb.tokens) == solo(pb, 6)
+        assert list(rc.tokens) == solo(pref, 6)
+        assert engine.spec_proposed > 0
+        assert engine.blocks_in_use == 0
+        assert engine.shared_blocks == 0
+
+    def test_soak_quantized_sharing_spec_zero_retraces_at_budget(
+            self, gpt):
+        """The ISSUE-8 trace-discipline soak: quantization on TOP of
+        sharing + drafting + heterogeneous sampling stays at exactly
+        FIVE executables × 1 trace with zero retraces after warmup —
+        the scale maintenance lives inside the existing step
+        executables, it adds none."""
+        model, params = gpt
+        engine = PagedEngine(model, params, max_slots=3, block_size=8,
+                             prefill_chunk=4, share_prefixes=True,
+                             spec_tokens=3, kv_dtype="int8")
+        sched = Scheduler(engine)
+        engine.warmup()
+        budget = {"decode_step": 1, "prefill_step": 1, "spec_step": 1,
+                  "admit": 1, "release": 1}
+        assert engine.trace_counts == budget
+
+        rng = np.random.default_rng(79)
+        pref = rng.integers(0, model.cfg.vocab_size,
+                            size=(16,)).astype(np.int32)
+        before = tracecheck.trace_event_count()
+        reqs = []
+        for i in range(8):
+            if i % 2 == 0:
+                prompt = np.concatenate([pref, rng.integers(
+                    0, model.cfg.vocab_size,
+                    size=(1 + i // 2,)).astype(np.int32)])
+            else:
+                prompt = rng.integers(
+                    0, model.cfg.vocab_size,
+                    size=(3 + i,)).astype(np.int32)
+            t, k, p = [(0.0, None, None), (0.8, 20, None),
+                       (1.2, 5, 0.9)][i % 3]
+            reqs.append(sched.submit(Request(
+                prompt=prompt, max_new_tokens=3 + i % 4,
+                temperature=t, top_k=k, top_p=p, seed=i)))
+        sched.drain()
+        assert tracecheck.trace_event_count() == before, (
+            "quantized sharing+spec soak retraced after warmup")
+        assert engine.trace_counts == budget
+        for r in reqs:
+            assert len(r.tokens) == r._budget0
+        assert engine.blocks_in_use == 0
+
+    def test_server_surfaces_kv_dtype_in_health_and_metrics(self, gpt):
+        model, params = gpt
+        rows = []
+        writer = MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+        server = InferenceServer(
+            model, params, max_slots=2, kv_cache="paged", block_size=8,
+            prefill_chunk=4, kv_dtype="int8", metrics=writer,
+            metrics_interval=2)
+        with server:
+            h = server.submit(np.arange(1, 9, dtype=np.int32),
+                              max_new_tokens=5)
+            h.result(timeout=300)
+            health = server.health()
+        assert health["kv_dtype"] == "int8"
+        assert health["kv_bits"] == 8
+        merged = {}
+        for _, m in rows:
+            merged.update(m)
+        assert merged.get("kv_bits") == 8.0
+        # unquantized servers report the storage width of the compute
+        # dtype and kv_dtype None
+        server2 = InferenceServer(
+            model, params, max_slots=1, kv_cache="paged", block_size=8,
+            prefill_chunk=4)
+        with server2:
+            h2 = server2.health()
+        assert h2["kv_dtype"] is None and h2["kv_bits"] == 32
+
+    def test_kv_dtype_auto_adopts_tuned_pair(self, gpt, tmp_path,
+                                             monkeypatch):
+        """block_size=0 + kv_dtype='auto' adopts the joint
+        (block_size, kv_dtype) winner from the autotune table; with
+        nothing cached it stays unquantized at the default block."""
+        model, params = gpt
+        monkeypatch.setenv("APEX_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at.json"))
+        from apex_tpu.ops import autotune
+
+        autotune.clear_cache()
+        try:
+            cold = PagedEngine(model, params, max_slots=1,
+                               block_size=0, kv_dtype="auto")
+            assert cold.kv_dtype is None and cold.block_size == 16
+            autotune._store(
+                autotune._key("paged_attention_pair",
+                              int(model.cfg.head_dim),
+                              str(jnp.dtype(model.cfg.dtype))),
+                [8, "int8"])
+            warm = PagedEngine(model, params, max_slots=1,
+                               block_size=0, kv_dtype="auto")
+            assert warm.kv_dtype == "int8" and warm.block_size == 8
+            assert warm.kv_bits == 8
+            # an explicit block size opts OUT of the joint pair (the
+            # caller overrode the tuner): auto resolves to unquantized
+            expl = PagedEngine(model, params, max_slots=1,
+                               block_size=8, kv_dtype="auto")
+            assert expl.kv_dtype is None
+        finally:
+            autotune.clear_cache()
+
+
+@pytest.mark.slow
+class TestQuantizedAccuracySlow:
+    """The ISSUE-8 accuracy acceptance on a TRAINED proxy (a random
+    init's near-tied logits flip under any perturbation and measure
+    nothing): ≥95% greedy token agreement vs ``generate()`` over a
+    multi-request soak horizon with kv_dtype='int8'."""
+
+    def test_greedy_token_agreement_at_least_95pct(self):
+        import jax as _jax
+
+        from apex_tpu.models import GPTConfig, GPTModel, gpt_loss_fn
+
+        cfg = GPTConfig.tiny(position_embedding="learned",
+                             scan_layers=True)
+        model = GPTModel(cfg)
+        rng = np.random.default_rng(0)
+        period = 24
+        cyc = rng.permutation(min(cfg.vocab_size, 256))[:period] \
+            .astype(np.int32)
+        tparams = model.init(_jax.random.PRNGKey(0),
+                             jnp.zeros((1, 4), jnp.int32))["params"]
+
+        def cyc_batch(bs, L):
+            phases = rng.integers(0, period, size=bs)
+            idx = (phases[:, None] + np.arange(L + 1)) % period
+            return jnp.asarray(cyc[idx])
+
+        @_jax.jit
+        def sgd_step(p, ids, lr):
+            def loss_fn(p):
+                logits = model.apply({"params": p}, ids[:, :-1],
+                                     deterministic=True)
+                return gpt_loss_fn(logits, ids[:, 1:])
+            loss, grads = _jax.value_and_grad(loss_fn)(p)
+            return _jax.tree.map(lambda a, g: a - lr * g, p, grads), \
+                loss
+
+        steps = 200
+        for i in range(steps):
+            tparams, _ = sgd_step(
+                tparams, cyc_batch(8, 48),
+                jnp.float32(0.5 if i < steps // 2 else 0.2))
+        trained = {"params": tparams}
+
+        budget = 20
+        prompts = [np.asarray(
+            cyc[(ph + np.arange(period + period // 2)) % period],
+            np.int32) for ph in range(6)]
+        engine = PagedEngine(model, trained, max_slots=3, block_size=8,
+                             prefill_chunk=8, kv_dtype="int8")
+        sched = Scheduler(engine)
+        reqs = [sched.submit(Request(prompt=p, max_new_tokens=budget))
+                for p in prompts]
+        sched.drain()
+        agree = total = 0
+        for p, r in zip(prompts, reqs):
+            ref = np.asarray(generate(
+                model, trained, jnp.asarray(p[None]),
+                max_new_tokens=budget))[0, len(p):]
+            got = np.asarray(r.tokens)
+            agree += int((got == ref).sum())
+            total += budget
+        assert engine.blocks_in_use == 0
+        assert agree / total >= 0.95, (
+            f"int8 KV greedy agreement {agree}/{total} "
+            f"= {agree / total:.3f} < 0.95")

@@ -25,14 +25,18 @@ SUBSET = [
     "tests/test_batch_norm.py",    # fused BN(+add+ReLU) kernels (ISSUE 3)
     # paged-attention decode kernel (ISSUE 5): scalar-prefetch block
     # tables + the DMA-skip clamp are exactly what interpret mode
-    # cannot prove — the gather path must run on the real chip
+    # cannot prove — the gather path must run on the real chip.  The
+    # quantized twin (ISSUE 8) adds the int8/fp8 page DMA + the (1,1)
+    # per-page scale blocks through the same index maps — Mosaic must
+    # compile the in-register dequant and the 1-byte tiles for real
     "tests/test_paged_attention.py",
     # prefix-shared CoW pages + speculative decoding (ISSUE 7): the
     # refcount/trie accounting and the drafted-step verify rollback
     # must hold against REAL pool pages — on chip a leaked or
     # double-freed page corrupts a co-tenant's KV instead of a numpy
     # shadow, and the spec_step executable must Mosaic-compile at its
-    # 1+K width
+    # 1+K width.  TestQuantizedKV (ISSUE 8) additionally pins the
+    # quantize-on-write scatter + scale reset against real HBM pages
     "tests/test_paged_serving.py",
     "tests/test_layer_norm.py",
     "tests/test_ops.py",
