@@ -174,6 +174,10 @@ class ResilientCheckpointer:
             else default_counters
         os.makedirs(self.directory, exist_ok=True)
         self._worker: Optional[threading.Thread] = None
+        # written by the async-save thread, read+cleared by the next
+        # save()/wait() — which always join the worker first, so the
+        # join's happens-before edge orders every access
+        # graftlint: unguarded(join-ordered: save()/wait() join the worker thread before touching it)
         self._worker_error: Optional[BaseException] = None
 
     # ---------------------------------------------------------- listing

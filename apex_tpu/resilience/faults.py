@@ -231,8 +231,8 @@ class FaultPlan:
         self.faults: Tuple[FaultSpec, ...] = tuple(faults)
         self.seed = int(seed)
         self._lock = threading.Lock()
-        self._fired: Dict[int, int] = {}
-        self._site_calls: Dict[str, int] = {}
+        self._fired: Dict[int, int] = {}  # graftlint: guarded-by(_lock)
+        self._site_calls: Dict[str, int] = {}  # graftlint: guarded-by(_lock)
 
     # ------------------------------------------------------------ state
     def reset(self) -> None:

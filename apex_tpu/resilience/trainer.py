@@ -131,7 +131,7 @@ class _Watchdog:
         self.ewma: Optional[float] = None
         self.fired_step: Optional[int] = None
         self._lock = threading.Lock()
-        self._armed_step: Optional[int] = None
+        self._armed_step: Optional[int] = None  # graftlint: guarded-by(_lock)
         self._deadline_at: float = 0.0
         self._observed = 0
         self._stop = False

@@ -245,9 +245,13 @@ class InferenceServer:
                                    queue_capacity=queue_capacity)
         self.metrics = metrics
         self.metrics_interval = max(1, int(metrics_interval))
+        # identity-keyed handle registry: client threads setitem/pop,
+        # the worker get/pops — every touch is one GIL-atomic dict op,
+        # it is never iterated, and keys are unique per request
+        # graftlint: unguarded(single atomic dict ops per touch, identity keys, never iterated)
         self._handles: dict = {}          # uid -> RequestHandle
         self._wakeup = threading.Condition()
-        self._stop = False
+        self._stop = False  # graftlint: guarded-by(_wakeup)
         self._drain_on_stop = True
         self._draining = False
         self._drain_evicted = 0
@@ -264,12 +268,19 @@ class InferenceServer:
         self._deadline_expired = 0
         # latency telemetry: time-to-first-token per request and
         # per-step decode wall time, bounded reservoirs (p50/p99 ride
-        # every metrics emission and the soak summary)
-        self._ttft: deque = deque(maxlen=2048)
-        self._step_times: deque = deque(maxlen=4096)
+        # every metrics emission and the soak summary).  The worker
+        # appends while any thread (fleet supervisor SLO probes,
+        # clients) snapshots — iterating a deque during an append
+        # raises RuntimeError, so both sides hold _lat_lock (the
+        # pre-existing race graftlint's concurrency pass flagged)
+        self._lat_lock = threading.Lock()
+        self._ttft: deque = deque(maxlen=2048)  # graftlint: guarded-by(_lat_lock)
+        self._step_times: deque = deque(maxlen=4096)  # graftlint: guarded-by(_lat_lock)
         #: the exception that killed the worker loop, if any — clients
-        #: see ServerClosed; the root cause lives here for post-mortems
-        self.error: Optional[BaseException] = None
+        #: see ServerClosed; the root cause lives here for post-mortems.
+        #: Published under _wakeup together with the _stop flip, so a
+        #: reader that saw _stop also sees the cause
+        self.error: Optional[BaseException] = None  # graftlint: guarded-by(_wakeup)
 
     # ---------------------------------------------------------- lifecycle
     def start(self, *, warmup: bool = True) -> "InferenceServer":
@@ -413,7 +424,7 @@ class InferenceServer:
             raise
 
     # ------------------------------------------------------------- worker
-    def _serve(self) -> None:
+    def _serve(self) -> None:  # graftlint: thread-entry(serving-worker)
         try:
             while True:
                 with self._wakeup:
@@ -439,8 +450,9 @@ class InferenceServer:
                     faults.inject("serving.step", step=attempt)
                     t_step0 = time.monotonic()
                     events = self.scheduler.run_step()
-                    self._step_times.append(
-                        time.monotonic() - t_step0)
+                    with self._lat_lock:
+                        self._step_times.append(
+                            time.monotonic() - t_step0)
                 except faults.TransientError as exc:
                     # a retryable step fault: the raiser guarantees
                     # engine state is intact (host-side failure, raised
@@ -467,7 +479,9 @@ class InferenceServer:
                         # first token of this request (requeued
                         # continuations keep their prefix, so this
                         # fires exactly once per request)
-                        self._ttft.append(now - ev.request.accepted_at)
+                        with self._lat_lock:
+                            self._ttft.append(
+                                now - ev.request.accepted_at)
                     handle = self._handles.get(id(ev.request))
                     if handle is not None:
                         handle._deliver(ev.token, ev.finished)
@@ -481,12 +495,16 @@ class InferenceServer:
         except BaseException as exc:    # noqa: BLE001 — any engine
             # failure (RetraceError, OOM, ...) must not strand clients:
             # record it, flip _stop so submit()/blocking waiters see a
-            # closed server, and fall through to the cancel path below
-            self.error = exc
+            # closed server, and fall through to the cancel path below.
+            # Both published under _wakeup: a reader that observed the
+            # stop flag must also observe its cause
             with self._wakeup:
+                self.error = exc
                 self._stop = True
                 self._wakeup.notify_all()
         finally:
+            with self._wakeup:
+                error = self.error
             # cancel every leftover queued/in-flight handle (normal
             # wait=False shutdown reaches here too; after a full drain
             # there is simply nothing left to cancel)
@@ -497,7 +515,7 @@ class InferenceServer:
             for slot, req in enumerate(self.scheduler._slots):
                 if req is None:
                     continue
-                if self.error is None:
+                if error is None:
                     self.engine.release(slot)
                 self.scheduler._slots[slot] = None
                 handle = self._handles.pop(id(req), None)
@@ -596,14 +614,18 @@ class InferenceServer:
         over the bounded reservoirs (seconds / milliseconds) — the
         soak-summary numbers; also folded into every metrics
         emission."""
-        # snapshot first: the worker thread appends concurrently, and
-        # iterating a deque during an append raises RuntimeError
+        # snapshot under _lat_lock: the worker thread appends
+        # concurrently, and iterating a deque during an append raises
+        # RuntimeError — list(deque) iterates too, so the snapshot
+        # itself must exclude the appender, not just downstream use
+        with self._lat_lock:
+            ttft = list(self._ttft)
+            step_times = list(self._step_times)
         out: Dict[str, float] = {}
         out.update(percentile_summary(
-            list(self._ttft), "ttft_p50_s", "ttft_p99_s"))
+            ttft, "ttft_p50_s", "ttft_p99_s"))
         out.update(percentile_summary(
-            list(self._step_times), "step_ms_p50", "step_ms_p99",
-            scale=1e3))
+            step_times, "step_ms_p50", "step_ms_p99", scale=1e3))
         return out
 
     def _emit_metrics(self, now: float) -> None:
@@ -664,7 +686,8 @@ class InferenceServer:
             alive = self._thread is not None and self._thread.is_alive()
             stopping = self._stop
             draining = self._draining
-        if self.error is not None:
+            error = self.error
+        if error is not None:
             status = "failed"
         elif not alive or stopping:
             status = "stopped"
@@ -685,7 +708,7 @@ class InferenceServer:
             "deadline_expired": self._deadline_expired,
             "drain_evicted": self._drain_evicted,
             "preempts": self.scheduler.preempts,
-            "error": None if self.error is None else repr(self.error),
+            "error": None if error is None else repr(error),
         }
         blocks_total = getattr(self.engine, "blocks_total", None)
         if blocks_total:

@@ -153,12 +153,17 @@ class CircuitBreaker:
         self.eject_after = int(eject_after)
         self.cooldown_s = float(cooldown_s)
         self.probation_probes = int(probation_probes)
+        # transitions happen under _mutex; the router's gate reads the
+        # state string unlocked (one atomic load — at worst a probe
+        # routes to a replica ejected this instant, which the retry
+        # path absorbs)
+        # graftlint: unguarded(writes under _mutex; unlocked readers take one atomic str load, staleness absorbed by routing retries)
         self.state = HEALTHY
         # RLock: on_latency_breach re-enters on_failure
         self._mutex = threading.RLock()
-        self._fails = 0
-        self._oks = 0
-        self._ejected_at: Optional[float] = None
+        self._fails = 0  # graftlint: guarded-by(_mutex)
+        self._oks = 0  # graftlint: guarded-by(_mutex)
+        self._ejected_at: Optional[float] = None  # graftlint: guarded-by(_mutex)
 
     @property
     def routable(self) -> bool:
@@ -207,6 +212,7 @@ class CircuitBreaker:
                 return self.state
             return self.on_failure(now)
 
+    # graftlint: requires-lock(_mutex)
     def _eject(self, now: float) -> None:
         # callers hold self._mutex
         self.state = EJECTED
@@ -438,19 +444,29 @@ class FleetRouter:
         self._breaker_factory = breaker_factory or CircuitBreaker
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # append-only replica table (replicas are marked dead, never
+        # removed): appends hold _lock; unlocked readers (monitors,
+        # _live() on lock-free paths) index or iterate a list that
+        # only grows, which CPython reads atomically — at worst a
+        # probe misses a replica added this instant
+        # graftlint: unguarded(append-only under _lock; unlocked iteration/indexing of a grow-only list is atomic per op)
         self._replicas: List[Optional[_Replica]] = []
-        self._requests: Dict[int, _FleetRequest] = {}
-        self._migq: Deque[int] = deque()
+        self._requests: Dict[int, _FleetRequest] = {}  # graftlint: guarded-by(_lock)
+        self._migq: Deque[int] = deque()  # graftlint: guarded-by(_lock)
         self._pump_lock = threading.Lock()
         self._uid = itertools.count()
         self._route_steps = itertools.count()
-        self._ttft: Deque[float] = deque(maxlen=4096)
-        self._submitted = 0
-        self._completed = 0
-        self._failed = 0
-        self._migrated = 0
-        self._tokens_total = 0
-        self._scale_cooldown = 0
+        # TTFT reservoir: replica worker taps append (under _cv, which
+        # IS _lock) while the supervisor/clients snapshot — unlocked,
+        # list(deque)-during-append raises RuntimeError (the
+        # pre-existing race graftlint's concurrency pass flagged)
+        self._ttft: Deque[float] = deque(maxlen=4096)  # graftlint: guarded-by(_lock)
+        self._submitted = 0  # graftlint: guarded-by(_lock)
+        self._completed = 0  # graftlint: guarded-by(_lock)
+        self._failed = 0  # graftlint: guarded-by(_lock)
+        self._migrated = 0  # graftlint: guarded-by(_lock)
+        self._tokens_total = 0  # graftlint: guarded-by(_lock)
+        self._scale_cooldown = 0  # graftlint: guarded-by(_lock)
         self._running = False
         self._stopping = False
         self._stop_supervisor = False
@@ -742,15 +758,20 @@ class FleetRouter:
                                      finished)
         return tap
 
+    # graftlint: thread-entry(replica-worker)
     def _on_inner_token(self, rec: _FleetRequest, replica_index: int,
                         token: int, finished: bool) -> None:
         """A replica delivered one token (its worker thread): mirror
         it into the fleet handle and record it for migration."""
-        if not rec.tokens:
-            self._ttft.append(time.monotonic() - rec.accepted_at)
+        first = not rec.tokens
+        if first:           # clock read off the per-token hot path;
+            # computed before taking _cv so lock-wait is not counted
+            ttft = time.monotonic() - rec.accepted_at
         rec.tokens.append(int(token))
         rec.handle._deliver(int(token), bool(finished))
         with self._cv:
+            if first:
+                self._ttft.append(ttft)
             self._tokens_total += 1
             if finished:
                 rep = self._replicas[replica_index]
@@ -760,6 +781,7 @@ class FleetRouter:
                 self._completed += 1
                 self._cv.notify_all()
 
+    # graftlint: thread-entry(replica-worker)
     def _on_inner_error(self, rec: _FleetRequest, replica_index: int,
                         error: BaseException) -> None:
         """A replica failed this request.  :class:`ServerClosed` (the
@@ -949,9 +971,10 @@ class FleetRouter:
             except TimeoutError:
                 pass                       # resumed next tick
             return None
-        if self._scale_cooldown > 0:
-            self._scale_cooldown -= 1
-            return None
+        with self._lock:
+            if self._scale_cooldown > 0:
+                self._scale_cooldown -= 1
+                return None
         depth = sum(h.get("queue_depth", 0)
                     for h in self._healths(healths).values())
         ttft = self.latency_summary().get("ttft_p99_s")
@@ -967,11 +990,12 @@ class FleetRouter:
             except TimeoutError:
                 pass       # the draining branch above finishes it
         if decision:
-            self._scale_cooldown = cfg.cooldown_ticks
+            with self._lock:
+                self._scale_cooldown = cfg.cooldown_ticks
         return decision
 
     # --------------------------------------------------------- supervisor
-    def _supervise(self) -> None:
+    def _supervise(self) -> None:  # graftlint: thread-entry(fleet-supervisor)
         tick = 0
         next_tick = time.monotonic()
         while True:
@@ -1146,9 +1170,13 @@ class FleetRouter:
         the router accepted (migration pauses included — the client's
         honest first-token wait), plus the worst per-replica decode
         step p99 (``step_ms_p99_max``)."""
+        # snapshot under _lock: replica workers append concurrently,
+        # and iterating a deque during an append raises RuntimeError
+        with self._lock:
+            ttft = list(self._ttft)
         out: Dict[str, float] = {}
         out.update(percentile_summary(
-            list(self._ttft), "ttft_p50_s", "ttft_p99_s"))
+            ttft, "ttft_p50_s", "ttft_p99_s"))
         p99s = []
         for rep in self._live():
             try:

@@ -89,11 +89,15 @@ class Scheduler:
                 f"queue_capacity must be >= 1, got {queue_capacity}")
         self.engine = engine
         self.queue_capacity = int(queue_capacity)
-        self._queue: Deque[Request] = deque()
+        self._queue: Deque[Request] = deque()  # graftlint: guarded-by(_lock)
         self._lock = threading.Lock()
         self._uid = itertools.count()
         # host shadow of slot occupancy — the device active mask is
-        # never read back outside step()
+        # never read back outside step().  Fixed-length: only the
+        # serving worker assigns items (never resizes), so a monitor
+        # thread's iteration (occupancy/has_work) reads each cell
+        # atomically and cannot raise or tear
+        # graftlint: unguarded(fixed-size list, item writes by the engine-owning worker only; iteration safe)
         self._slots: List[Optional[Request]] = [None] * engine.max_slots
         self._admit_failures: List[Tuple[Request, BaseException]] = []
         #: block-exhaustion preemptions requeued so far (paged engine)
@@ -250,12 +254,14 @@ class Scheduler:
             admitted += 1
         return admitted
 
+    # graftlint: thread-entry(serving-worker)
     def take_admit_failures(self) -> List[Tuple[Request, BaseException]]:
         """Drain requests whose admission failed terminally (the
         serving loop routes these to their handles)."""
         failed, self._admit_failures = self._admit_failures, []
         return failed
 
+    # graftlint: thread-entry(serving-worker)
     def evict(self, slot: int) -> Optional[Request]:
         """Release ``slot`` (zero the engine row) and return its
         tenant — deadline-expiry and fault-recovery path.  Call from
@@ -267,6 +273,7 @@ class Scheduler:
         self._slots[slot] = None
         return req
 
+    # graftlint: thread-entry(serving-worker)
     def evict_all(self) -> List[Request]:
         """Evict every active tenant and return them in slot order —
         the graceful-drain path (``InferenceServer.begin_drain``).
@@ -281,6 +288,7 @@ class Scheduler:
                 evicted.append(req)
         return evicted
 
+    # graftlint: thread-entry(serving-worker)
     def run_step(self) -> List[StepEvent]:
         """One step boundary: admit → decode → route/evict.
 
@@ -336,6 +344,7 @@ class Scheduler:
                     self._slots[slot] = None
         return events
 
+    # graftlint: single-threaded(synchronous convenience for tests/batch scripts; no server thread runs beside it)
     def drain(self) -> List[StepEvent]:
         """Run steps until queue and slots are empty; returns every
         event in emission order (synchronous convenience for tests and

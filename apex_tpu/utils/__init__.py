@@ -24,6 +24,7 @@ from apex_tpu.utils.tracecheck import (
     RetraceError, retrace_guard, trace_event_count,
     reset_trace_event_count,
 )
+from apex_tpu.utils import lockcheck
 
 __all__ = [
     "is_floating",
@@ -42,4 +43,5 @@ __all__ = [
     "MetricsWriter", "log_metrics", "namespaced_sink",
     "RetraceError", "retrace_guard", "trace_event_count",
     "reset_trace_event_count",
+    "lockcheck",
 ]

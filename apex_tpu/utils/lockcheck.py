@@ -1,0 +1,497 @@
+"""Runtime lock sanitizer — the dynamic oracle behind graftlint's
+concurrency pass.
+
+``tools/graftlint``'s whole-program rules catch thread-hygiene bugs
+*statically*: unguarded multi-thread-reachable fields, ``guarded-by``
+fields touched without their lock, cyclic lock-acquisition order (see
+``docs/graftlint.md``).  This module is the matching *runtime*
+tripwire, the way :mod:`apex_tpu.utils.tracecheck` backs the
+trace-hygiene rules: :func:`instrument` wraps an object's
+``threading`` locks with an acquisition-order recorder, and — in the
+strict mode the chaos soaks run under — asserts that every
+``# graftlint: guarded-by(<lock>)`` field is only touched while its
+declared lock is held.
+
+Two checks, mirroring the static rules:
+
+- **Order inversions** (static twin: ``lock-order-cycle``): every
+  acquisition of lock B while lock A is held records the edge A→B in
+  a process-wide order graph; observing the reverse edge B→A — or
+  re-acquiring a non-reentrant ``Lock`` the thread already holds — is
+  a potential deadlock and is reported with both witness sites.
+  Observed orders are *actual* orders, so there are no
+  interprocedural approximations: what fires here deadlocks for real
+  under the right interleaving.
+
+- **Guarded accesses** (strict mode; static twin:
+  ``guarded-by-violation``): the instance's class is swapped for a
+  recording subclass whose ``__getattribute__``/``__setattr__``
+  verify, for every access of an annotated field *from the class's
+  own methods* (``self.<field>`` — the same surface the static pass
+  models; external pokes by tests are exempt, as are methods marked
+  ``# graftlint: single-threaded(...)``), that the current thread
+  holds the declared lock.  Condition aliases resolve to their
+  underlying lock, so ``guarded-by(_lock)`` is satisfied inside
+  ``with self._cv:`` when ``_cv = Condition(self._lock)``.
+
+Violations are *recorded*, never raised at the fault site (raising
+inside a worker loop would change the very scheduling being observed);
+the soak asserts at the end::
+
+    from apex_tpu.utils import lockcheck
+
+    lockcheck.reset()
+    lockcheck.instrument(server, strict=True)   # scheduler/metrics too
+    ... run the soak ...
+    lockcheck.assert_clean()                    # zero reports
+
+The chaos-smoke CI job exports ``APEX_TPU_LOCKCHECK=strict``;
+``instrument(obj)`` with no explicit ``strict=`` follows that env
+(default non-strict: order recording only).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import re
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "LockCheckError",
+    "instrument",
+    "env_strict",
+    "reports",
+    "reset",
+    "assert_clean",
+]
+
+_ENV = "APEX_TPU_LOCKCHECK"
+
+_LOCK_TYPE = type(threading.Lock())
+_RLOCK_TYPES = tuple({type(threading.RLock())} | (
+    {threading._RLock} if hasattr(threading, "_RLock") else set()))
+
+
+class LockCheckError(AssertionError):
+    """Raised by :func:`assert_clean` when the sanitizer has reports."""
+
+
+def env_strict() -> bool:
+    """True when ``APEX_TPU_LOCKCHECK=strict`` (the chaos-smoke CI
+    job's setting)."""
+    return os.environ.get(_ENV, "").strip().lower() == "strict"
+
+
+# ---------------------------------------------------------------- recorder
+
+class _Node:
+    """One lock identity: a raw ``threading`` lock (a Condition and
+    the lock it wraps share one node).  Holds the raw lock itself —
+    the registry keys on ``id(raw)``, so the node must pin the object
+    alive or a freed lock's recycled address would alias a NEW lock to
+    this stale node (wrong name, wrong ``reentrant`` flag → spurious
+    self-deadlock reports, or suppressed real ones)."""
+
+    __slots__ = ("name", "reentrant", "raw")
+
+    def __init__(self, name: str, reentrant: bool, raw: Any):
+        self.name = name
+        self.reentrant = reentrant
+        self.raw = raw
+
+    @property
+    def raw_id(self) -> int:
+        return id(self.raw)
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+class _Recorder:
+    """Process-wide acquisition-order graph + violation log."""
+
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self._tls = threading.local()
+        # raw lock id -> node (first instrumenter names it)
+        self.nodes: Dict[int, _Node] = {}
+        # (id(a), id(b)) -> witness site string for edge a->b
+        self.edges: Dict[Tuple[int, int], str] = {}
+        self.violations: List[str] = []
+        self._reported: Set[Tuple] = set()
+
+    # ------------------------------------------------------ held stack
+    def _stack(self) -> List[_Node]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def holds(self, node: _Node) -> bool:
+        return any(h is node for h in self._stack())
+
+    def acquired(self, node: _Node, site: str) -> None:
+        stack = self._stack()
+        with self._mutex:
+            if any(h is node for h in stack):
+                if not node.reentrant:
+                    self._report(
+                        ("self", node.raw_id),
+                        f"lock re-acquired while held: {node} at "
+                        f"{site} — a non-reentrant Lock deadlocks "
+                        f"here (static twin: lock-order-cycle "
+                        f"self-edge)")
+            else:
+                for held in stack:
+                    if held is node:
+                        continue
+                    fwd = (held.raw_id, node.raw_id)
+                    rev = (node.raw_id, held.raw_id)
+                    self.edges.setdefault(
+                        fwd, f"{held} -> {node} at {site}")
+                    if rev in self.edges:
+                        pair = (min(fwd), max(fwd))
+                        self._report(
+                            ("inversion", pair),
+                            f"lock-order inversion: {held} -> {node} "
+                            f"at {site}, but the reverse order was "
+                            f"observed: {self.edges[rev]} — two "
+                            f"threads taking these in opposite "
+                            f"orders deadlock")
+        stack.append(node)
+
+    def released(self, node: _Node) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is node:
+                del stack[i]
+                return
+        # release without a recorded acquire: the lock was taken
+        # before instrumentation (or handed across threads) — not a
+        # discipline violation, just outside the observation window
+
+    # ------------------------------------------------------- reporting
+    def _report(self, key: Tuple, message: str) -> None:
+        # one report per distinct (kind, site) — a soak loop hitting
+        # the same race a thousand times is one finding
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.violations.append(message)
+
+    def guard_violation(self, key: Tuple, message: str) -> None:
+        with self._mutex:
+            self._report(key, message)
+
+
+_recorder = _Recorder()
+
+
+def reports() -> List[str]:
+    """Every violation recorded since the last :func:`reset`."""
+    with _recorder._mutex:
+        return list(_recorder.violations)
+
+
+def reset() -> None:
+    """Clear the order graph and violation log (test isolation).
+    Already-instrumented objects keep recording into the fresh state."""
+    with _recorder._mutex:
+        _recorder.edges.clear()
+        _recorder.violations.clear()
+        _recorder._reported.clear()
+
+
+def assert_clean() -> None:
+    """Raise :class:`LockCheckError` listing every recorded violation
+    (no-op when clean) — the soak's closing assertion."""
+    found = reports()
+    if found:
+        listing = "\n  ".join(found)
+        raise LockCheckError(
+            f"lockcheck: {len(found)} violation(s):\n  {listing}")
+
+
+# ------------------------------------------------------------ lock proxies
+
+def _site() -> str:
+    """``file:line`` of the first caller frame outside this module."""
+    frame = sys._getframe(2)
+    here = __file__
+    while frame is not None and frame.f_code.co_filename == here:
+        frame = frame.f_back
+    if frame is None:                      # pragma: no cover - defensive
+        return "<unknown>"
+    return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+
+
+class _LockProxy:
+    """Records acquire/release of one raw lock (or Condition — which
+    shares its underlying lock's node).  Everything else delegates, so
+    ``wait``/``notify`` and identity-insensitive uses keep working."""
+
+    def __init__(self, inner: Any, node: _Node):
+        object.__setattr__(self, "_lc_inner", inner)
+        object.__setattr__(self, "_lc_node", node)
+
+    # the with-statement / explicit-acquire surface
+    def acquire(self, *args: Any, **kwargs: Any) -> bool:
+        got = self._lc_inner.acquire(*args, **kwargs)
+        if got:
+            _recorder.acquired(self._lc_node, _site())
+        return got
+
+    def release(self) -> None:
+        self._lc_inner.release()
+        _recorder.released(self._lc_node)
+
+    def __enter__(self) -> "_LockProxy":
+        self._lc_inner.__enter__()
+        _recorder.acquired(self._lc_node, _site())
+        return self
+
+    def __exit__(self, *exc: Any) -> Any:
+        out = self._lc_inner.__exit__(*exc)
+        _recorder.released(self._lc_node)
+        return out
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._lc_inner, name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        setattr(self._lc_inner, name, value)
+
+    def __repr__(self) -> str:
+        return f"lockcheck({self._lc_node.name})"
+
+
+def _raw_lock_of(value: Any) -> Tuple[Optional[Any], bool]:
+    """(raw underlying lock, is_reentrant) for a lock-like ``value``;
+    (None, False) when it is not lock-like."""
+    if isinstance(value, _LockProxy):
+        return None, False                  # already instrumented
+    if isinstance(value, _LOCK_TYPE):
+        return value, False
+    if isinstance(value, _RLOCK_TYPES):
+        return value, True
+    if isinstance(value, threading.Condition):
+        inner = value._lock
+        if isinstance(inner, _LockProxy):
+            inner = inner._lc_inner
+        return inner, not isinstance(inner, _LOCK_TYPE)
+    return None, False
+
+
+# ----------------------------------------------------- annotation scanning
+
+_GUARD_RE = re.compile(
+    r"self\.(\w+)\s*(?::[^=]+)?=.*#\s*graftlint:\s*guarded-by\((\w+)\)")
+#: the standalone form — a `# graftlint: guarded-by(<lock>)` comment
+#: line directly above the assignment (for lines too long to carry a
+#: trailing mark); the static pass honors both, so must we
+_GUARD_MARK_RE = re.compile(r"graftlint:\s*guarded-by\((\w+)\)")
+_GUARD_ASSIGN_RE = re.compile(r"self\.(\w+)\s*(?::[^=]+)?=")
+_EXEMPT_DEF_RE = re.compile(r"def\s+(\w+)\s*\(")
+
+_annotation_cache: Dict[type, Tuple[Dict[str, str], Set[str]]] = {}
+
+
+def _class_annotations(cls: type) -> Tuple[Dict[str, str], Set[str]]:
+    """(field -> declared lock attr, exempt method names) parsed from
+    the class source's ``# graftlint:`` marks.  ``thread-entry``
+    methods are *not* exempt (they run concurrently); only
+    ``single-threaded`` ones are."""
+    cached = _annotation_cache.get(cls)
+    if cached is not None:
+        return cached
+    guards: Dict[str, str] = {}
+    exempt: Set[str] = set()
+    for klass in cls.__mro__:
+        if klass is object:
+            continue
+        try:
+            source = inspect.getsource(klass)
+        except (OSError, TypeError):
+            continue
+        pending_single = False
+        pending_guard: Optional[str] = None
+        in_init = False
+        for line in source.splitlines():
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                if re.search(r"graftlint:\s*single-threaded\(",
+                             stripped):
+                    pending_single = True
+                gm = _GUARD_MARK_RE.search(stripped)
+                # `directly above` means exactly that: any other
+                # comment line between the mark and the assignment
+                # breaks the attachment (mirrors the static pass)
+                pending_guard = gm.group(1) if gm is not None else None
+                continue
+            d = _EXEMPT_DEF_RE.match(stripped)
+            if d:
+                in_init = d.group(1) == "__init__"
+                if pending_single or (
+                        "# graftlint: single-threaded(" in line):
+                    exempt.add(d.group(1))
+            elif in_init:
+                # guards register on __init__ assignments only — the
+                # same surface the static convention declares them on
+                m = _GUARD_RE.search(line)
+                if m and m.group(1) not in guards:
+                    guards[m.group(1)] = m.group(2)
+                elif pending_guard is not None:
+                    a = _GUARD_ASSIGN_RE.match(stripped)
+                    if a and a.group(1) not in guards:
+                        guards[a.group(1)] = pending_guard
+            pending_single = False
+            pending_guard = None
+    _annotation_cache[cls] = (guards, exempt)
+    return guards, exempt
+
+
+# -------------------------------------------------------- strict subclass
+
+_strict_cache: Dict[type, type] = {}
+
+
+def _check_guard(obj: Any, field: str, lock_attr: str,
+                 exempt: Set[str], access: str) -> None:
+    # 0=_check_guard, 1=__getattribute__/__setattr__, 2=the accessor
+    frame = sys._getframe(2)
+    if frame.f_locals.get("self") is not obj:
+        return          # external poke (tests, reprs) — out of model
+    if frame.f_code.co_name in exempt or frame.f_code.co_name == "__init__":
+        return
+    try:
+        guard = object.__getattribute__(obj, lock_attr)
+    except AttributeError:
+        return
+    if not isinstance(guard, _LockProxy):
+        return          # the guard itself was not instrumented
+    node = object.__getattribute__(guard, "_lc_node")
+    if _recorder.holds(node):
+        return
+    cls = type(obj).__mro__[1].__name__     # the un-instrumented class
+    _recorder.guard_violation(
+        (access, cls, field, frame.f_code.co_filename, frame.f_lineno),
+        f"guarded field {access} without its lock: `{cls}.{field}` "
+        f"is declared guarded-by({lock_attr}) but "
+        f"{frame.f_code.co_name} at {frame.f_code.co_filename}:"
+        f"{frame.f_lineno} touches it without holding it (static "
+        f"twin: guarded-by-violation)")
+
+
+def _strict_class(cls: type) -> Optional[type]:
+    """A subclass of ``cls`` whose attribute protocol verifies the
+    ``guarded-by`` discipline; None when the class has no annotated
+    fields (nothing to verify — skip the overhead)."""
+    cached = _strict_cache.get(cls)
+    if cached is not None:
+        return cached
+    guards, exempt = _class_annotations(cls)
+    if not guards:
+        return None
+
+    def __getattribute__(self: Any, name: str) -> Any:
+        if name in guards:
+            _check_guard(self, name, guards[name], exempt, "read")
+        return super(strict, self).__getattribute__(name)
+
+    def __setattr__(self: Any, name: str, value: Any) -> None:
+        if name in guards:
+            _check_guard(self, name, guards[name], exempt, "write")
+        super(strict, self).__setattr__(name, value)
+
+    strict = type(
+        f"_LockChecked{cls.__name__}", (cls,),
+        {"__getattribute__": __getattribute__,
+         "__setattr__": __setattr__,
+         "__module__": cls.__module__})
+    _strict_cache[cls] = strict
+    return strict
+
+
+# ------------------------------------------------------------- instrument
+
+def instrument(obj: Any, *, strict: Optional[bool] = None,
+               recurse: int = 2, _visited: Optional[Set[int]] = None
+               ) -> Any:
+    """Wrap ``obj``'s ``threading`` locks with the order recorder and
+    (strict mode) enable guarded-field verification; returns ``obj``.
+
+    - Every ``Lock``/``RLock``/``Condition`` in ``obj.__dict__`` is
+      replaced by a recording proxy (a Condition and the lock it was
+      built over share one identity, so ``guarded-by(_lock)`` holds
+      inside ``with self._cv:``).
+    - ``strict=None`` follows ``APEX_TPU_LOCKCHECK=strict`` (the
+      chaos-smoke CI setting); pass ``strict=True`` to force it (the
+      chaos soaks do).
+    - ``recurse`` walks that many levels of apex_tpu-owned instance
+      attributes (and list/dict elements), so instrumenting an
+      ``InferenceServer`` also covers its scheduler and metrics
+      writer, and a ``FleetRouter`` its replicas and breakers.
+
+    Idempotent: re-instrumenting is a no-op per lock, and objects
+    created *after* instrumentation (scale-up replicas) can be
+    instrumented as they appear.
+
+    Instrument **before** the object's threads start (before
+    ``server.start()`` / ``fleet.start()``): a thread inside a
+    ``with``-block of the *raw* lock at swap time would briefly hold
+    it invisibly, and strict mode would misread its guarded accesses
+    as unlocked.
+    """
+    if strict is None:
+        strict = env_strict()
+    if _visited is None:
+        _visited = set()
+    if id(obj) in _visited or isinstance(obj, _LockProxy):
+        return obj
+    _visited.add(id(obj))
+    d = getattr(obj, "__dict__", None)
+    if not isinstance(d, dict):
+        return obj
+    cls_name = type(obj).__name__
+    if cls_name.startswith("_LockChecked"):
+        cls_name = cls_name[len("_LockChecked"):]
+    had_locks = False
+    for attr, value in list(d.items()):
+        raw, reentrant = _raw_lock_of(value)
+        if raw is None:
+            if isinstance(value, _LockProxy):
+                had_locks = True
+            continue
+        had_locks = True
+        with _recorder._mutex:
+            node = _recorder.nodes.get(id(raw))
+            if node is None:
+                node = _Node(f"{cls_name}.{attr}", reentrant, raw)
+                _recorder.nodes[id(raw)] = node
+        d[attr] = _LockProxy(value, node)
+    if strict and had_locks \
+            and not type(obj).__name__.startswith("_LockChecked"):
+        strict_cls = _strict_class(type(obj))
+        if strict_cls is not None:
+            try:
+                obj.__class__ = strict_cls
+            except TypeError:          # pragma: no cover - slots etc.
+                pass
+    if recurse > 0:
+        children: List[Any] = []
+        for value in list(d.values()):
+            if isinstance(value, (list, tuple)):
+                children.extend(value)
+            elif isinstance(value, dict):
+                children.extend(value.values())
+            else:
+                children.append(value)
+        for child in children:
+            mod = getattr(type(child), "__module__", "") or ""
+            if mod.partition(".")[0] == "apex_tpu":
+                instrument(child, strict=strict, recurse=recurse - 1,
+                           _visited=_visited)
+    return obj

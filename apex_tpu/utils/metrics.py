@@ -44,7 +44,7 @@ class Counters:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {}
+        self._counts: Dict[str, int] = {}  # graftlint: guarded-by(_lock)
 
     def inc(self, name: str, n: int = 1) -> int:
         """Add ``n`` to ``name`` (created at 0); returns the new value."""
@@ -94,10 +94,13 @@ class MetricsWriter:
 
     def __init__(self, sink: Optional[Callable[[int, Dict[str, float]],
                                                None]] = None):
-        self.history: List[Tuple[int, Dict[str, float]]] = []
+        # appended (insort) only while _drain_lock is held; external
+        # readers conventionally consume it after drains complete —
+        # list reads are per-op atomic either way
+        self.history: List[Tuple[int, Dict[str, float]]] = []  # graftlint: guarded-by(_drain_lock)
         self._sink = sink
-        self._pending: Dict[int, Dict[str, float]] = {}
-        self._seen: set = set()
+        self._pending: Dict[int, Dict[str, float]] = {}  # graftlint: guarded-by(_lock)
+        self._seen: set = set()  # graftlint: guarded-by(_lock)
         self._lock = threading.Lock()
         # serializes whole drains (staging lock alone would let two
         # drains interleave their history/sink phases out of order);
@@ -106,7 +109,7 @@ class MetricsWriter:
         # one past the largest step ever staged — the fresh-step axis
         # merge()/advance_step() allocate from when aggregating writers
         # whose own step counters collide
-        self._axis = 0
+        self._axis = 0  # graftlint: guarded-by(_lock)
 
     def __call__(self, step: int, metrics: Dict[str, Any]) -> None:
         step = int(step)

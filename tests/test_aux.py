@@ -273,6 +273,47 @@ class TestMetrics:
         fleet.drain()
         assert rows == [(0, {"replica3/tps": 2.5, "replica3/step": 5.0})]
 
+    def test_merge_concurrent_with_emitters_exactly_once(self):
+        """ISSUE-9 concurrency audit of merge()/namespaced_sink: the
+        aggregator pulls while source writers' emitter threads keep
+        staging — the discipline the ``guarded-by`` annotations on
+        ``_pending``/``_seen``/``_axis``/``history`` declare.  Every
+        (source, step) lands exactly once, nothing is lost or
+        duplicated, and the combined history stays step-sorted."""
+        import threading
+
+        n_steps = 150
+        agg = utils.MetricsWriter(sink=lambda s, m: None)
+        sources = {f"r{i}": utils.MetricsWriter() for i in range(3)}
+
+        def emit(w):
+            for s in range(n_steps):
+                w(s, {"v": float(s)})
+
+        threads = [threading.Thread(target=emit, args=(w,))
+                   for w in sources.values()]
+        for t in threads:
+            t.start()
+        merged = []
+        while any(t.is_alive() for t in threads):
+            merged += agg.merge(sources)    # pull mid-emission
+        for t in threads:
+            t.join()
+        merged += agg.merge(sources)        # sweep the tail
+        per_source_steps = {}
+        for _, row in merged:
+            name = next(iter(row)).split("/")[0]
+            per_source_steps.setdefault(name, []).append(
+                row[f"{name}/step"])
+        assert set(per_source_steps) == set(sources)
+        for name, steps in per_source_steps.items():
+            # exactly once each, and per-source order preserved
+            assert steps == sorted(steps) == [float(s)
+                                              for s in range(n_steps)]
+        agg.drain()
+        hist = [s for s, _ in agg.history]
+        assert hist == sorted(hist) and len(hist) == 3 * n_steps
+
 
 class TestProfiler:
     """jax.profiler wrappers (SURVEY.md §5 tracing row — exceeds the

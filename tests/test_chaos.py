@@ -24,6 +24,14 @@ Two acceptance soaks for the resilience layer (docs/resilience.md):
   with ``kv_dtype="int8"`` — zero lost/hung, ``blocks_in_use == 0``
   (per-page scales freed with their pages), budgets exactly 5 × 1.
 
+The serving and fleet soaks also run under the **strict runtime lock
+sanitizer** (``apex_tpu.utils.lockcheck``, ISSUE 9): every lock in the
+stack is wrapped with an acquisition-order recorder and every
+``# graftlint: guarded-by`` field access is verified to hold its
+declared lock — the soak asserts zero reports at the end.  The
+chaos-smoke CI job exports ``APEX_TPU_LOCKCHECK=strict`` to document
+the mode; the soaks force ``strict=True`` regardless.
+
 CI runs these in the dedicated ``chaos-smoke`` job (small configs,
 CPU).  They carry ``slow`` too: the tier-1 ``-m 'not slow'`` gate
 already rides its wall-clock budget, and these three dots cost ~a
@@ -52,7 +60,7 @@ from apex_tpu.resilience import (
 )
 from apex_tpu.serving import FleetRouter, InferenceServer, RequestFailed
 from apex_tpu.transformer.testing import standalone_gpt
-from apex_tpu.utils import MetricsWriter, tracecheck
+from apex_tpu.utils import MetricsWriter, lockcheck, tracecheck
 
 pytestmark = [pytest.mark.chaos, pytest.mark.slow]
 
@@ -192,6 +200,11 @@ class TestServingChaosSoak:
         model, params = self._tiny()
         server = InferenceServer(model, params, max_slots=3,
                                  prompt_buckets=(4, 8, 16))
+        # runtime lock sanitizer, strict: order-inversion recording on
+        # every lock in the stack plus guarded-by field verification
+        # (docs/graftlint.md) — instrumented before the worker starts
+        lockcheck.reset()
+        lockcheck.instrument(server, strict=True)
         # transient faults throughout the soak (attempt counter: every
         # 5th decode attempt), plus one admission-path fault
         plan = FaultPlan([
@@ -255,6 +268,9 @@ class TestServingChaosSoak:
         assert after == before, "chaos soak retraced after warmup"
         assert server.engine.trace_counts == {
             "decode_step": 1, "prefill": 3, "admit": 1, "release": 1}
+        # the strict lock sanitizer observed the whole storm: zero
+        # order inversions, zero guarded-field touches without locks
+        lockcheck.assert_clean()
 
     def test_worker_survives_and_serves_after_faults(self):
         """After the fault plan is exhausted the same server keeps
@@ -554,9 +570,13 @@ class TestFleetChaosSoak:
 
     def _factory(self, model, params):
         def factory():
-            return InferenceServer(
+            # each replica is lock-sanitized as it is built — before
+            # the fleet warms/starts it, so no thread can be inside a
+            # raw critical section at instrumentation time
+            return lockcheck.instrument(InferenceServer(
                 model, params, max_slots=2, kv_cache="paged",
-                block_size=8, pool_tokens=256, prefill_chunk=4)
+                block_size=8, pool_tokens=256, prefill_chunk=4),
+                strict=True)
         return factory
 
     def _wait_live(self, handles, min_tokens=2, timeout=180.0):
@@ -580,6 +600,8 @@ class TestFleetChaosSoak:
         vocab = model.cfg.vocab_size
         router = FleetRouter(self._factory(model, params), replicas=3,
                              probe_interval=0.05)
+        lockcheck.reset()
+        lockcheck.instrument(router, strict=True)
         rng = np.random.default_rng(31)
         greedy_cases = [(4, 12), (7, 10), (3, 14), (6, 11), (9, 9),
                         (2, 13)]
@@ -654,12 +676,17 @@ class TestFleetChaosSoak:
             + stats["failed"]
         # migration replays compiled programs — no retraces anywhere
         assert after == before, "fleet kill soak retraced"
+        # and the whole storm ran under the strict lock sanitizer:
+        # zero order inversions, zero unguarded guarded-field touches
+        lockcheck.assert_clean()
 
     def test_drain_under_load_is_loss_free(self):
         model, params = self._tiny()
         vocab = model.cfg.vocab_size
         router = FleetRouter(self._factory(model, params), replicas=2,
                              probe_interval=0.05)
+        lockcheck.reset()
+        lockcheck.instrument(router, strict=True)
         rng = np.random.default_rng(37)
         cases = [(4, 10), (6, 9), (3, 12), (8, 8), (5, 11)]
         with router:
@@ -702,3 +729,5 @@ class TestFleetChaosSoak:
                 assert rep.server.engine.blocks_in_use == 0
                 assert rep.server.engine.trace_counts \
                     == self.PAGED_BUDGET
+        # drain + scale-up ran under the strict lock sanitizer too
+        lockcheck.assert_clean()

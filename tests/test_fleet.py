@@ -657,3 +657,50 @@ class TestFleetHealth:
             merged.update(m)
         assert merged.get("fleet/kv_bits_min") == 8.0
         router.shutdown(wait=False)
+
+
+class TestFleetLatencySummarySnapshotRace:
+    """Regression twin of the server-side fix (ISSUE 9, flagged by the
+    graftlint concurrency pass): replica worker taps append to the
+    router's ``_ttft`` reservoir (via ``_on_inner_token``, under
+    ``_cv`` — which wraps ``_lock``) while the supervisor and clients
+    snapshot it in ``latency_summary()``.  Iterating a deque during an
+    append raises ``RuntimeError``; the snapshot now happens under
+    ``_lock``.  The hammer fails within milliseconds unlocked."""
+
+    def test_snapshot_survives_concurrent_tap_appends(self):
+        import threading
+        from collections import deque
+
+        router = FleetRouter.__new__(FleetRouter)
+        router._lock = threading.Lock()
+        router._cv = threading.Condition(router._lock)
+        router._ttft = deque(maxlen=4096)
+        router._replicas = []               # no live replicas: p99s skip
+        for i in range(512):
+            with router._cv:
+                router._ttft.append(0.01 * i)
+        stop = threading.Event()
+        errors = []
+
+        def tap_thread():                   # _on_inner_token's append path
+            i = 0
+            try:
+                while not stop.is_set():
+                    with router._cv:
+                        router._ttft.append(0.01 * (i % 11))
+                    i += 1
+            except BaseException as exc:    # pragma: no cover
+                errors.append(exc)
+
+        t = threading.Thread(target=tap_thread)
+        t.start()
+        try:
+            deadline = time.monotonic() + 0.8
+            while time.monotonic() < deadline:
+                out = router.latency_summary()
+                assert set(out) == {"ttft_p50_s", "ttft_p99_s"}
+        finally:
+            stop.set()
+            t.join()
+        assert errors == []

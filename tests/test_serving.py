@@ -599,3 +599,53 @@ class TestDrainKillAndHealthFields:
         assert server.error is not None
         server.kill()                           # idempotent
         server.shutdown()                       # and shutdown-safe
+
+
+class TestLatencySummarySnapshotRace:
+    """Regression for a real pre-existing cross-thread race the
+    graftlint concurrency pass flagged (ISSUE 9): the worker thread
+    appends to the ``_ttft``/``_step_times`` reservoirs while any
+    thread (fleet supervisor SLO probes, clients) snapshots them in
+    ``latency_summary()`` — and iterating a deque during an append
+    raises ``RuntimeError``.  Both sides now hold ``_lat_lock``; this
+    hammer fails within milliseconds on the unlocked code."""
+
+    def test_snapshot_survives_concurrent_appends(self):
+        import threading
+        import time as _time
+        from collections import deque
+
+        srv = InferenceServer.__new__(InferenceServer)
+        srv._lat_lock = threading.Lock()
+        srv._ttft = deque(maxlen=2048)
+        srv._step_times = deque(maxlen=4096)
+        for i in range(512):                    # pre-fill: long iteration
+            with srv._lat_lock:
+                srv._ttft.append(0.01 * i)
+                srv._step_times.append(0.002)
+        stop = threading.Event()
+        errors = []
+
+        def worker():
+            i = 0
+            try:
+                while not stop.is_set():
+                    with srv._lat_lock:         # the worker's append path
+                        srv._ttft.append(0.01 * (i % 7))
+                        srv._step_times.append(0.002 + 1e-5 * (i % 3))
+                    i += 1
+            except BaseException as exc:        # pragma: no cover
+                errors.append(exc)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        try:
+            deadline = _time.monotonic() + 0.8
+            while _time.monotonic() < deadline:
+                out = srv.latency_summary()
+                assert set(out) == {"ttft_p50_s", "ttft_p99_s",
+                                    "step_ms_p50", "step_ms_p99"}
+        finally:
+            stop.set()
+            t.join()
+        assert errors == []

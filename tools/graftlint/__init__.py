@@ -1,24 +1,36 @@
-"""graftlint — JAX trace-hygiene static analyzer for this repo.
+"""graftlint — JAX trace-hygiene + concurrency static analyzer.
 
 Catches the footgun class that silently erases fused-kernel wins:
 trace-time environment capture, python branching on traced values,
 cache-defeating jit signatures, wall-clock/RNG/print side effects
 baked into traces, and mutable global state touched from traced code.
 
+v2 adds whole-program **concurrency** rules over the threaded serving
+stack (``tools/graftlint/concurrency.py``): instance fields reachable
+from multiple thread entry points without a declared lock discipline,
+``guarded-by(<lock>)`` annotations checked at every access,
+``requires-lock`` caller contracts, and lock-order cycles (potential
+deadlocks) across the interprocedural acquisition graph.
+
 CLI::
 
     python -m tools.graftlint apex_tpu tools examples
     python -m tools.graftlint --list-rules
     python -m tools.graftlint --format json apex_tpu
+    python -m tools.graftlint --timings apex_tpu
 
 Exit status: 0 clean, 1 findings, 2 usage error.  Docs:
-``docs/graftlint.md``.  The runtime counterpart (a retrace counter
-tests can assert on) is :mod:`apex_tpu.utils.tracecheck`.
+``docs/graftlint.md``.  The runtime counterparts (guards tests can
+assert on) are :mod:`apex_tpu.utils.tracecheck` (retrace counter) and
+:mod:`apex_tpu.utils.lockcheck` (acquisition-order recorder + strict
+guarded-field verification).
 """
 
 from tools.graftlint.core import (
-    Finding, Rule, all_rules, lint_paths, lint_path, lint_source, main,
+    Finding, Program, ProgramRule, Rule, all_program_rules, all_rules,
+    lint_paths, lint_path, lint_source, main, run_stats,
 )
 
-__all__ = ["Finding", "Rule", "all_rules", "lint_paths", "lint_path",
-           "lint_source", "main"]
+__all__ = ["Finding", "Program", "ProgramRule", "Rule",
+           "all_program_rules", "all_rules", "lint_paths", "lint_path",
+           "lint_source", "main", "run_stats"]

@@ -1,0 +1,864 @@
+"""graftlint concurrency pass — whole-program thread-hygiene analysis.
+
+The trace-hygiene rules (``rules.py``) are per-file; the serving stack's
+bugs are not.  ``InferenceServer`` worker threads, the ``FleetRouter``
+supervisor, async checkpoint writers and cross-thread metrics pipelines
+share instance fields across threads, and every recent review pass
+caught a real race by hand (a cross-thread deque iteration, a
+CircuitBreaker needing an RLock, an unlocked supervisor counter).  This
+pass makes that review machine-checked:
+
+1. **Thread-entry inference** — for every *concurrent class* (one that
+   owns a ``threading.Lock``/``RLock``/``Condition``/``Event`` or
+   starts a ``threading.Thread``), each ``Thread(target=self.m)`` /
+   ``Thread(target=nested_def)`` roots its own thread group, and every
+   public method roots the shared ``client`` group (callable from any
+   client thread).  ``# graftlint: thread-entry(<group>)`` on a ``def``
+   line declares a callback that runs on another thread (a fleet tap
+   executed by a replica worker); ``# graftlint: single-threaded(<why>)``
+   excludes a method that runs before/without concurrency (warmup).
+
+2. **Interprocedural walk** — from each entry the pass walks
+   ``self.m()`` calls, property reads, and one level of typed-field
+   calls (``self.scheduler.run_step()`` resolves through the
+   ``self.scheduler = Scheduler(...)`` assignment in ``__init__``,
+   when the target class is itself concurrent), carrying the set of
+   locks lexically held (``with self._lock:`` regions, with
+   ``Condition(self._lock)`` aliasing resolved) across call edges.
+
+3. **Shared-field discipline** — a field *mutated* from two groups, or
+   mutated in one and *iterated* in another (the deque-``RuntimeError``
+   shape), must carry an annotation on its ``__init__`` assignment:
+   ``# graftlint: guarded-by(<lock>)`` (every access must then hold the
+   lock — checked) or ``# graftlint: unguarded(<why>)`` (a deliberate,
+   justified exception: single-writer publish, GIL-atomic ops,
+   join-ordering).  Single-atomic reads (``len()``, subscript loads,
+   membership, ``next()``) never count as hazardous touches; scalar
+   fields written from exactly one group are the CPython-safe
+   single-writer-publish idiom and pass unannotated.
+
+4. **Lock discipline helpers** — ``# graftlint: requires-lock(<lock>)``
+   on a ``def`` line asserts the caller holds the lock: the body is
+   analyzed as holding it, and any call site that does not hold it is
+   flagged.
+
+5. **Lock-order cycles** — every ``with self.<lockB>:`` entered while
+   ``<lockA>`` is held (lexically or through the call graph, across
+   classes) adds edge A→B to a program-wide acquisition graph; a
+   strongly-connected component (or a self-edge on a non-reentrant
+   ``Lock``) is a potential deadlock and is reported with its witness
+   sites.
+
+The runtime twin is :mod:`apex_tpu.utils.lockcheck`, which wraps the
+stack's locks and observes the *actual* acquisition order under the
+chaos soaks.  ``docs/graftlint.md`` documents the rule catalog, the
+annotation convention, and the resulting thread map.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (
+    Finding,
+    ModuleContext,
+    ProgramRule,
+    dotted_name,
+    last_attr,
+    register_program,
+)
+
+__all__ = ["analyze_program"]
+
+# ---------------------------------------------------------------- marks
+
+_MARK_RE = re.compile(
+    r"graftlint:\s*"
+    r"(guarded-by|unguarded|requires-lock|thread-entry|single-threaded)"
+    r"\(([^)]*)\)")
+
+#: lock-like constructors (the acquisition graph's node types)
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+#: internally-synchronized types: never themselves shared-field hazards
+_SYNC_CTORS = {"Event", "Semaphore", "BoundedSemaphore", "Barrier",
+               "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+#: container constructors/literals (iteration across threads can raise
+#: or tear; mutation needs a discipline)
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter"}
+#: container methods that mutate in place
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "add",
+             "update", "setdefault", "pop", "popleft", "popitem",
+             "insert", "remove", "discard", "clear", "rotate"}
+#: calls whose read of a container argument is a single atomic op
+_ATOMIC_CALLS = {"len", "bool", "repr", "id", "next", "isinstance",
+                 "hasattr", "type", "callable"}
+#: calls that iterate their container argument
+_ITERATING_CALLS = {"list", "tuple", "sorted", "set", "frozenset",
+                    "sum", "min", "max", "any", "all", "iter",
+                    "reversed", "enumerate", "zip", "map", "filter",
+                    "dict"}
+#: methods returning live iteration views — traversing one during a
+#: concurrent mutation raises the same RuntimeError as iterating the
+#: container directly (`.copy()` is excluded: C-level, GIL-atomic)
+_ITER_VIEW_METHODS = {"values", "items", "keys"}
+
+CLIENT = "client"
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``X`` for ``self.X``, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_threading_ctor(node: ast.AST, names: Dict[str, str]) -> Optional[str]:
+    """Kind for ``threading.Lock()``-style calls (see ``names``)."""
+    if not isinstance(node, ast.Call):
+        return None
+    la = last_attr(node.func)
+    return names.get(la) if la else None
+
+
+@dataclasses.dataclass
+class _Access:
+    group: str
+    kind: str            # "write" | "iter" | "read"
+    ctx: ModuleContext
+    node: ast.AST
+    held: FrozenSet[Tuple[str, str]]     # {(class, lock-attr), ...}
+
+
+@dataclasses.dataclass
+class _Field:
+    name: str
+    kind: str = "opaque"      # container | scalar | primitive | opaque
+    init_ctx: Optional[ModuleContext] = None
+    init_node: Optional[ast.AST] = None
+    guard: Optional[str] = None
+    unguarded_reason: Optional[str] = None
+    accesses: List[_Access] = dataclasses.field(default_factory=list)
+
+    def groups(self, kind: str) -> Set[str]:
+        return {a.group for a in self.accesses if a.kind == kind}
+
+
+class _ClassModel:
+    """Static model of one (possibly concurrent) class."""
+
+    def __init__(self, ctx: ModuleContext, node: ast.ClassDef):
+        self.ctx = ctx
+        self.node = node
+        self.name = node.name
+        self.methods: Dict[str, ast.AST] = {}
+        self.properties: Set[str] = set()
+        self.locks: Dict[str, str] = {}        # attr -> lock|rlock|condition
+        self.alias: Dict[str, str] = {}        # condition attr -> lock attr
+        self.field_class: Dict[str, str] = {}  # attr -> class name
+        self.fields: Dict[str, _Field] = {}
+        self.requires: Dict[str, Set[str]] = {}
+        self.entry_marks: Dict[str, str] = {}        # method -> group
+        self.single_threaded: Set[str] = set()
+        self.starts_thread = False
+        # (root function node, group, enclosing method or None)
+        self.thread_roots: List[Tuple[ast.AST, str]] = []
+        self._scan()
+
+    # ------------------------------------------------------------ scan
+    def _marks_for_line(self, line: int) -> List[Tuple[str, str]]:
+        """Marks on ``line`` — trailing, or on a *standalone* comment
+        directly above (for lines too long to carry the mark; a
+        trailing comment on the previous code line never leaks down)."""
+        sup = self.ctx.suppressions
+        text = sup.graftlint_comments.get(line, "")
+        if line - 1 in sup.standalone_comment_lines:
+            text += " " + sup.graftlint_comments.get(line - 1, "")
+        return _MARK_RE.findall(text)
+
+    def _scan(self) -> None:
+        for item in self.node.body:
+            if isinstance(item, _FuncDef):
+                self.methods[item.name] = item
+                if any(last_attr(d) == "property"
+                       for d in item.decorator_list):
+                    self.properties.add(item.name)
+                for mark, arg in self._marks_for_line(item.lineno):
+                    arg = arg.strip()
+                    if mark == "requires-lock":
+                        self.requires.setdefault(item.name, set()).add(arg)
+                    elif mark == "thread-entry":
+                        self.entry_marks[item.name] = arg or item.name
+                    elif mark == "single-threaded":
+                        self.single_threaded.add(item.name)
+        init = self.methods.get("__init__")
+        if init is not None:
+            self._scan_init(init)
+        # thread creation anywhere in the class body
+        for node in ast.walk(self.node):
+            if isinstance(node, ast.Call) \
+                    and last_attr(node.func) == "Thread":
+                self.starts_thread = True
+                target = next(
+                    (k.value for k in node.keywords if k.arg == "target"),
+                    None)
+                if target is None and node.args:
+                    target = node.args[0]
+                if target is None:
+                    continue
+                attr = _self_attr(target)
+                if attr and attr in self.methods:
+                    fn = self.methods[attr]
+                    group = self.entry_marks.get(attr, f"thread:{attr}")
+                    self.thread_roots.append((fn, group))
+                elif isinstance(target, ast.Name):
+                    # nested def passed by name (async checkpoint /
+                    # prefetch worker style)
+                    enclosing = self.ctx.enclosing_function(node)
+                    for cand in ast.walk(self.node):
+                        if isinstance(cand, _FuncDef) \
+                                and cand.name == target.id \
+                                and cand is not enclosing \
+                                and self.ctx.enclosing_function(cand) \
+                                is enclosing:
+                            self.thread_roots.append(
+                                (cand, f"thread:{cand.name}"))
+
+    def _scan_init(self, init: ast.AST) -> None:
+        for node in ast.walk(init):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets, value = [node.target], None
+            for target in targets:
+                attr = _self_attr(target)
+                if attr is None:
+                    continue
+                lock_kind = value is not None and _is_threading_ctor(
+                    value, _LOCK_CTORS)
+                if lock_kind:
+                    self.locks[attr] = lock_kind
+                    if lock_kind == "condition" and value.args:
+                        inner = _self_attr(value.args[0])
+                        if inner:
+                            self.alias[attr] = inner
+                    continue
+                field = self.fields.setdefault(attr, _Field(attr))
+                if field.init_node is None:
+                    field.init_ctx = self.ctx
+                    field.init_node = node
+                    field.kind = self._classify(value)
+                for mark, arg in self._marks_for_line(node.lineno):
+                    if mark == "guarded-by":
+                        field.guard = arg.strip()
+                    elif mark == "unguarded":
+                        field.unguarded_reason = arg.strip()
+                # `self.x = self.y = ...` or conditional re-assigns:
+                # keep the first classification
+                if value is not None and isinstance(value, ast.Call):
+                    callee = last_attr(value.func)
+                    # resolvable field type (for cross-class walking)
+                    if callee and callee[:1].isupper() \
+                            and attr not in self.field_class:
+                        self.field_class[attr] = callee
+
+    @staticmethod
+    def _classify(value: Optional[ast.AST]) -> str:
+        if value is None:
+            return "opaque"
+        if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+            return "container"
+        if isinstance(value, ast.Constant):
+            return "scalar"
+        if isinstance(value, ast.BinOp):
+            # [None] * n / base + [x] — a container built by arithmetic
+            for side in (value.left, value.right):
+                if _ClassModel._classify(side) == "container":
+                    return "container"
+            return "scalar"
+        if isinstance(value, (ast.UnaryOp, ast.Compare, ast.BoolOp)):
+            return "scalar"
+        if isinstance(value, ast.Call):
+            la = last_attr(value.func)
+            if la in _CONTAINER_CTORS:
+                return "container"
+            if la in _SYNC_CTORS:
+                return "primitive"
+            if la in ("int", "float", "bool", "str", "tuple", "max",
+                      "min", "abs", "round"):
+                return "scalar"
+        return "opaque"
+
+    # ------------------------------------------------------------ info
+    @property
+    def concurrent(self) -> bool:
+        return bool(self.locks) or self.starts_thread or any(
+            f.kind == "primitive" for f in self.fields.values())
+
+    def canonical_lock(self, attr: str) -> Optional[str]:
+        """Resolve condition aliases (``_cv`` wrapping ``_lock``)."""
+        if attr in self.alias:
+            return self.alias[attr]
+        if attr in self.locks:
+            return attr
+        return None
+
+    def client_roots(self) -> List[Tuple[ast.AST, str]]:
+        thread_fns = {id(fn) for fn, _ in self.thread_roots}
+        roots = []
+        for name, fn in self.methods.items():
+            if name == "__init__" or name in self.single_threaded:
+                continue
+            if id(fn) in thread_fns:
+                continue
+            if name in self.entry_marks:
+                roots.append((fn, self.entry_marks[name]))
+                continue
+            public = not name.startswith("_") or name in (
+                "__call__", "__enter__", "__exit__", "__iter__",
+                "__next__")
+            if public:
+                roots.append((fn, CLIENT))
+        return roots
+
+
+# ------------------------------------------------------------ the walk
+
+@dataclasses.dataclass(frozen=True)
+class _LockEdge:
+    held: Tuple[str, str]        # (class, lock attr)
+    acquired: Tuple[str, str]
+    ctx: ModuleContext
+    node: ast.AST
+
+
+class _Analysis:
+    """One whole-program concurrency analysis over a module set."""
+
+    MAX_DEPTH = 24
+
+    def __init__(self, contexts: List[ModuleContext]):
+        self.classes: Dict[str, _ClassModel] = {}
+        for ctx in contexts:
+            for node in ctx.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    model = _ClassModel(ctx, node)
+                    # first definition wins (names are unique in this
+                    # tree; a collision would only widen the analysis)
+                    self.classes.setdefault(model.name, model)
+        self.edges: List[_LockEdge] = []
+        self._edge_keys: Set[Tuple[Tuple[str, str], Tuple[str, str],
+                                   str, int]] = set()
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[str, int, str, FrozenSet]] = set()
+
+    # -------------------------------------------------------------- run
+    def run(self) -> List[Finding]:
+        for model in self.classes.values():
+            if not model.concurrent:
+                continue
+            for fn, group in model.thread_roots:
+                self._visit(model, fn, group, frozenset(), 0)
+            for fn, group in model.client_roots():
+                self._visit(model, fn, group, frozenset(), 0)
+        self._check_fields()
+        self._check_cycles()
+        return self.findings
+
+    # ------------------------------------------------------------ visit
+    def _visit(self, model: _ClassModel, fn: ast.AST, group: str,
+               held: FrozenSet[Tuple[str, str]], depth: int) -> None:
+        if depth > self.MAX_DEPTH:
+            return
+        name = getattr(fn, "name", "<lambda>")
+        held = held | frozenset(
+            (model.name, model.canonical_lock(req) or req)
+            for req in model.requires.get(name, ()))
+        key = (model.name, id(fn), group, held)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        self._scan_stmts(model, body, group, held, depth)
+
+    def _scan_stmts(self, model: _ClassModel, stmts, group: str,
+                    held: FrozenSet, depth: int) -> None:
+        for stmt in stmts:
+            self._scan_node(model, stmt, group, held, depth)
+
+    def _scan_node(self, model: _ClassModel, node: ast.AST, group: str,
+                   held: FrozenSet, depth: int) -> None:
+        if isinstance(node, (_FuncDef + (ast.Lambda,))):
+            # nested defs run who-knows-where (callbacks); they are
+            # analyzed only when rooted as thread targets
+            return
+        if isinstance(node, ast.With):
+            # items acquire left-to-right: each later item is taken
+            # while the earlier ones are held, so `with self._a,
+            # self._b:` records the a->b edge like nested withs do
+            inner = held
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is None:
+                    self._scan_node(model, item.context_expr, group,
+                                    inner, depth)
+                    continue
+                lock = model.canonical_lock(attr)
+                if lock is None:
+                    self._scan_node(model, item.context_expr, group,
+                                    inner, depth)
+                    continue
+                acq = (model.name, lock)
+                for h in inner:
+                    if h == acq and model.locks.get(lock) != "lock":
+                        continue        # re-entrant RLock/Condition
+                    self._add_edge(h, acq, model.ctx, node)
+                inner = inner | frozenset((acq,))
+            self._scan_stmts(model, node.body, group, inner, depth)
+            return
+        if isinstance(node, ast.Try):
+            self._scan_stmts(model, node.body, group, held, depth)
+            for handler in node.handlers:
+                self._scan_stmts(model, handler.body, group, held, depth)
+            self._scan_stmts(model, node.orelse, group, held, depth)
+            self._scan_stmts(model, node.finalbody, group, held, depth)
+            return
+        # expression-level handling first (so calls/accesses on this
+        # statement are recorded with the current held set)
+        self._scan_exprs(model, node, group, held, depth)
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(node, field, None)
+            if isinstance(sub, list):
+                self._scan_stmts(model, sub, group, held, depth)
+
+    def _scan_exprs(self, model: _ClassModel, stmt: ast.AST, group: str,
+                    held: FrozenSet, depth: int) -> None:
+        """Record accesses/calls in ``stmt``'s expressions (bodies of
+        compound statements are handled by the caller)."""
+        skip_fields = {"body", "orelse", "finalbody", "handlers"}
+        stack = [child for name, child in ast.iter_fields(stmt)
+                 if name not in skip_fields]
+        flat: List[ast.AST] = []
+        for child in stack:
+            if isinstance(child, ast.AST):
+                flat.append(child)
+            elif isinstance(child, list):
+                flat.extend(c for c in child if isinstance(c, ast.AST))
+        for root in flat:
+            for node in ast.walk(root):
+                if isinstance(node, (_FuncDef + (ast.Lambda,))):
+                    continue
+                self._record(model, node, group, held, depth)
+
+    # ---------------------------------------------------------- record
+    def _record(self, model: _ClassModel, node: ast.AST, group: str,
+                held: FrozenSet, depth: int) -> None:
+        ctx = model.ctx
+        parent = ctx.parent(node)
+        attr = _self_attr(node)
+        if attr is not None:
+            if attr in model.locks:
+                return
+            # self.m() / self.prop — walk, don't record a field access
+            if attr in model.methods:
+                fn = model.methods[attr]
+                is_call = isinstance(parent, ast.Call) \
+                    and parent.func is node
+                if is_call or attr in model.properties:
+                    self._call(model, attr, group, held, depth, node)
+                return
+            kind = self._access_kind(ctx, node, parent)
+            if kind is not None:
+                field = model.fields.setdefault(attr, _Field(attr))
+                field.accesses.append(
+                    _Access(group, kind, ctx, node, held))
+            return
+        # self.f.m() / self.f.attr — one level through a typed field
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Attribute):
+            base = _self_attr(node.value)
+            if base is None:
+                return
+            target_cls = model.field_class.get(base)
+            target = self.classes.get(target_cls) if target_cls else None
+            if target is None or not target.concurrent:
+                return
+            sub = node.attr
+            if sub in target.methods:
+                is_call = isinstance(parent, ast.Call) \
+                    and parent.func is node
+                if is_call or sub in target.properties:
+                    self._call(target, sub, group, held, depth, node)
+                return
+            kind = self._access_kind(ctx, node, parent)
+            if kind is not None:
+                field = target.fields.setdefault(sub, _Field(sub))
+                field.accesses.append(
+                    _Access(group, kind, ctx, node, held))
+
+    def _call(self, model: _ClassModel, name: str, group: str,
+              held: FrozenSet, depth: int, site: ast.AST) -> None:
+        if name in model.single_threaded:
+            return
+        if name in model.entry_marks \
+                and model.entry_marks[name] != group:
+            # the method runs on its own declared thread; its accesses
+            # are attributed by its own entry walk, not this caller's
+            return
+        required = model.requires.get(name, set())
+        missing = [req for req in required
+                   if (model.name, model.canonical_lock(req) or req)
+                   not in held]
+        if missing:
+            self._finding(
+                "requires-lock-violation", model.ctx, site,
+                f"call of `{model.name}.{name}` requires holding "
+                f"`{'`/`'.join(sorted(missing))}` "
+                f"(# graftlint: requires-lock) but no caller on this "
+                f"path acquires it")
+        self._visit(model, model.methods[name], group, held, depth + 1)
+
+    @staticmethod
+    def _access_kind(ctx: ModuleContext, node: ast.AST,
+                     parent: Optional[ast.AST]) -> Optional[str]:
+        """Classify one ``self.X`` occurrence.
+
+        Returns ``"write"`` (rebind, subscript store, in-place
+        mutator), ``"iter"`` (whole-container traversal — the
+        cross-thread ``RuntimeError`` / torn-read shape), ``"read"``
+        (plain load), or ``"atomic"`` for single-atomic ops (``len``,
+        subscript load, membership, ``next``).  Atomic ops are safe
+        under the GIL and never count toward the *sharing hazard*, but
+        they ARE recorded: a field *declared* ``guarded-by`` is
+        checked at every access — the discipline the runtime sanitizer
+        enforces too, so a graftlint-clean tree cannot fail the strict
+        chaos soaks on a statically-sanctioned accessor."""
+        if isinstance(getattr(node, "ctx", None), (ast.Store, ast.Del)):
+            return "write"
+        if isinstance(parent, ast.AugAssign) and parent.target is node:
+            return "write"
+        if isinstance(parent, ast.Subscript) and parent.value is node:
+            if isinstance(parent.ctx, (ast.Store, ast.Del)):
+                return "write"
+            return "atomic"                  # atomic subscript load
+        if isinstance(parent, ast.Attribute) and parent.value is node:
+            grand = ctx.parent(parent)
+            if isinstance(grand, ast.Call) and grand.func is parent:
+                if parent.attr in _MUTATORS:
+                    return "write"
+                if parent.attr in _ITER_VIEW_METHODS:
+                    return "iter"            # live view: traversal
+                return "read"                # unknown method: plain read
+            return "read"
+        if isinstance(parent, ast.Call):
+            fn_name = parent.func.id \
+                if isinstance(parent.func, ast.Name) else None
+            if parent.func is node:
+                return "read"                # calling the field
+            if fn_name in _ATOMIC_CALLS:
+                return "atomic"
+            if fn_name in _ITERATING_CALLS:
+                return "iter"
+            return "iter"        # unknown callee: conservative escape
+        if isinstance(parent, (ast.For, ast.comprehension)) \
+                and getattr(parent, "iter", None) is node:
+            return "iter"
+        if isinstance(parent, ast.Starred):
+            return "iter"
+        if isinstance(parent, ast.Compare) and node in parent.comparators \
+                and any(isinstance(op, (ast.In, ast.NotIn))
+                        for op in parent.ops):
+            return "atomic"                  # atomic membership test
+        return "read"
+
+    # --------------------------------------------------------- findings
+    def _finding(self, rule: str, ctx: ModuleContext, node: ast.AST,
+                 message: str) -> None:
+        f = Finding(rule, ctx.path, getattr(node, "lineno", 1),
+                    getattr(node, "col_offset", 0) + 1, message)
+        if f not in self.findings:
+            self.findings.append(f)
+
+    def _check_fields(self) -> None:
+        for model in self.classes.values():
+            if not model.concurrent:
+                continue
+            for field in model.fields.values():
+                self._check_field(model, field)
+
+    def _check_field(self, model: _ClassModel, field: _Field) -> None:
+        if field.kind == "primitive":
+            return
+        anchor_ctx = field.init_ctx or model.ctx
+        anchor = field.init_node or model.node
+        if field.guard is not None:
+            lock = model.canonical_lock(field.guard)
+            if lock is None:
+                self._finding(
+                    "guarded-by-violation", anchor_ctx, anchor,
+                    f"`{model.name}.{field.name}` declares guarded-by"
+                    f"({field.guard}) but `{field.guard}` is not a "
+                    f"lock attribute of {model.name}")
+                return
+            need = (model.name, lock)
+            for access in field.accesses:
+                if need not in access.held:
+                    self._finding(
+                        "guarded-by-violation", access.ctx, access.node,
+                        f"`{model.name}.{field.name}` is declared "
+                        f"guarded-by({field.guard}) but this "
+                        f"{access.kind} (thread group `{access.group}`)"
+                        f" does not hold it — wrap the access in "
+                        f"`with self.{field.guard}:` or mark the "
+                        f"method `# graftlint: requires-lock"
+                        f"({field.guard})`")
+            return
+        if field.unguarded_reason is not None:
+            if not field.unguarded_reason.strip():
+                self._finding(
+                    "unguarded-shared-field", anchor_ctx, anchor,
+                    f"`{model.name}.{field.name}` is marked unguarded() "
+                    f"with no justification — the reason is the point "
+                    f"of the annotation; say why the race is benign")
+            return
+        write_groups = field.groups("write")
+        iter_groups = field.groups("iter")
+        # scalars written from one group and read elsewhere are the
+        # CPython-safe single-writer publish idiom; the iteration
+        # hazard (torn traversal, deque/dict RuntimeError) is a
+        # container/opaque-object property
+        shared = len(write_groups) >= 2 or (
+            field.kind in ("container", "opaque")
+            and write_groups and (iter_groups - write_groups))
+        if not shared:
+            return
+        touches = sorted(write_groups | iter_groups)
+        self._finding(
+            "unguarded-shared-field", anchor_ctx, anchor,
+            f"`{model.name}.{field.name}` ({field.kind}) is touched "
+            f"from multiple thread groups ({', '.join(touches)}: "
+            f"writes from {sorted(write_groups)}, iteration from "
+            f"{sorted(iter_groups - write_groups) or '[]'}) with no "
+            f"declared discipline — annotate its __init__ assignment "
+            f"`# graftlint: guarded-by(<lock>)` (and hold the lock at "
+            f"every access) or `# graftlint: unguarded(<why the race "
+            f"is benign>)`")
+
+    # ------------------------------------------------------------ edges
+    def _add_edge(self, held: Tuple[str, str], acq: Tuple[str, str],
+                  ctx: ModuleContext, node: ast.AST) -> None:
+        key = (held, acq, ctx.path, getattr(node, "lineno", 0))
+        if key in self._edge_keys:
+            return
+        self._edge_keys.add(key)
+        self.edges.append(_LockEdge(held, acq, ctx, node))
+
+    def _check_cycles(self) -> None:
+        graph: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        witness: Dict[Tuple[Tuple[str, str], Tuple[str, str]],
+                      _LockEdge] = {}
+        for edge in self.edges:
+            if edge.held == edge.acquired:
+                # self-edge on a plain Lock: guaranteed self-deadlock
+                self._finding(
+                    "lock-order-cycle", edge.ctx, edge.node,
+                    f"`{edge.held[0]}.{edge.held[1]}` is re-acquired "
+                    f"while already held — a non-reentrant Lock "
+                    f"deadlocks here; use an RLock or restructure")
+                continue
+            graph.setdefault(edge.held, set()).add(edge.acquired)
+            witness.setdefault((edge.held, edge.acquired), edge)
+        for scc in _find_cycles(graph):
+            cycle = _cycle_in_scc(graph, scc)
+            if cycle is None:       # pragma: no cover - SCC guarantees one
+                continue
+            pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+            edges = [witness[p] for p in pairs if p in witness]
+            if not edges:           # pragma: no cover - pairs are edges
+                continue
+            edges.sort(key=lambda e: (e.ctx.path,
+                                      getattr(e.node, "lineno", 0)))
+            names = " -> ".join(f"{c}.{a}" for c, a in cycle)
+            sites = "; ".join(
+                f"{e.held[0]}.{e.held[1]}->{e.acquired[0]}."
+                f"{e.acquired[1]} at {e.ctx.path}:"
+                f"{getattr(e.node, 'lineno', 0)}" for e in edges)
+            self._finding(
+                "lock-order-cycle", edges[0].ctx, edges[0].node,
+                f"lock-order cycle {names} -> {cycle[0][0]}."
+                f"{cycle[0][1]} — two threads taking these locks in "
+                f"opposite orders deadlock; witnesses: {sites}")
+
+
+def _cycle_in_scc(graph: Dict[Tuple[str, str], Set[Tuple[str, str]]],
+                  scc: List[Tuple[str, str]]
+                  ) -> Optional[List[Tuple[str, str]]]:
+    """An actual elementary cycle through ``scc[0]`` built from
+    witnessed edges only: BFS from each successor back to the start,
+    restricted to the SCC.  Every adjacent pair of the returned list
+    (wrapping) is a real edge of ``graph`` — the sorted node order of
+    the SCC itself need not be (a 3-lock cycle oriented against the
+    sort would otherwise be dropped as witness-less)."""
+    scc_set = set(scc)
+    start = scc[0]
+    for succ in sorted(graph.get(start, ())):
+        if succ not in scc_set:
+            continue
+        prev: Dict[Tuple[str, str], Tuple[str, str]] = {succ: start}
+        queue = [succ]
+        while queue and start not in prev:
+            v = queue.pop(0)
+            for w in sorted(graph.get(v, ())):
+                if w in scc_set and w not in prev:
+                    prev[w] = v
+                    queue.append(w)
+        if start not in prev:
+            continue
+        # prev[x] -> x is an edge; walk back from start to succ
+        back = []
+        v = prev[start]
+        while v != start:
+            back.append(v)
+            v = prev[v]
+        return [start] + back[::-1]     # start -> succ -> ... -> back
+    return None
+
+
+def _find_cycles(graph: Dict[Tuple[str, str], Set[Tuple[str, str]]]
+                 ) -> List[List[Tuple[str, str]]]:
+    """Elementary cycles via SCC decomposition (one report per SCC:
+    the cycle along a back-path inside it — enough to name the locks
+    and a witness, without enumerating every permutation)."""
+    index: Dict[Tuple[str, str], int] = {}
+    low: Dict[Tuple[str, str], int] = {}
+    on_stack: Set[Tuple[str, str]] = set()
+    stack: List[Tuple[str, str]] = []
+    sccs: List[List[Tuple[str, str]]] = []
+    counter = [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            scc = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                scc.append(w)
+                if w == v:
+                    break
+            if len(scc) > 1:
+                sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def analyze_program(contexts: List[ModuleContext]) -> List[Finding]:
+    """Run the concurrency analysis; returns every finding (all three
+    rules) unfiltered — the runner applies suppressions."""
+    return _Analysis(list(contexts)).run()
+
+
+# ------------------------------------------------------- program rules
+
+class _ConcurrencyRule(ProgramRule):
+    """Shared driver: the analysis runs once per program (memoized on
+    the Program object by :meth:`prepare`, which the runner times
+    under the ``concurrency-pass`` row — not whichever of the four
+    rules happens to run first); each registered rule yields its
+    slice."""
+
+    shared_pass = "concurrency-pass"
+
+    def prepare(self, program) -> None:
+        if getattr(program, "_concurrency_findings", None) is None:
+            program._concurrency_findings = analyze_program(
+                program.contexts)
+
+    def check_program(self, program) -> Iterator[Finding]:
+        self.prepare(program)
+        for finding in program._concurrency_findings:
+            if finding.rule == self.name:
+                yield finding
+
+
+@register_program
+class UnguardedSharedField(_ConcurrencyRule):
+    """Rule C1 — multi-thread-reachable field with no lock discipline.
+
+    A ``self.*`` field mutated from two thread groups — or mutated in
+    one and iterated in another (the cross-thread deque
+    ``RuntimeError`` shape) — with neither a ``guarded-by(<lock>)``
+    nor a justified ``unguarded(<why>)`` annotation on its ``__init__``
+    assignment.
+    """
+
+    name = "unguarded-shared-field"
+    summary = ("instance field touched from multiple thread entry "
+               "points without guarded-by/unguarded annotation")
+
+
+@register_program
+class GuardedByViolation(_ConcurrencyRule):
+    """Rule C2 — access to a ``guarded-by`` field without its lock.
+
+    The declared lock (condition aliases resolved) must be held —
+    lexically or through a ``requires-lock``-marked caller — at every
+    access of an annotated field.
+    """
+
+    name = "guarded-by-violation"
+    summary = ("guarded-by(<lock>) field accessed on a path that does "
+               "not hold the declared lock")
+
+
+@register_program
+class RequiresLockViolation(_ConcurrencyRule):
+    """Rule C3 — ``requires-lock`` method called without the lock.
+
+    ``# graftlint: requires-lock(<lock>)`` on a ``def`` asserts the
+    caller holds the lock; a call reached on a path that does not is
+    flagged at the call site.
+    """
+
+    name = "requires-lock-violation"
+    summary = ("method marked requires-lock(<lock>) called on a path "
+               "that does not hold the lock")
+
+
+@register_program
+class LockOrderCycle(_ConcurrencyRule):
+    """Rule C4 — cyclic lock-acquisition order (potential deadlock).
+
+    Built from the static nesting of ``with self.<lock>:`` regions and
+    the calls made while they are held (across classes through typed
+    fields).  Any cycle — including re-acquiring a non-reentrant
+    ``Lock`` — is reported with its witness sites.
+    """
+
+    name = "lock-order-cycle"
+    summary = ("cyclic with-lock nesting across the call graph — "
+               "potential deadlock (witnesses listed)")

@@ -40,13 +40,16 @@ import io
 import json
 import os
 import sys
+import time
 import tokenize
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set
 
 __all__ = [
-    "Finding", "Rule", "ModuleContext", "register", "all_rules",
-    "lint_source", "lint_path", "lint_paths", "expr_tainted",
-    "taint_function", "closure_taint", "dotted_name", "main",
+    "Finding", "Rule", "ProgramRule", "ModuleContext", "Program",
+    "register", "register_program", "all_rules", "all_program_rules",
+    "load_context", "lint_source", "lint_path", "lint_paths",
+    "expr_tainted", "taint_function", "closure_taint", "dotted_name",
+    "main", "run_stats",
 ]
 
 
@@ -67,7 +70,10 @@ class Finding:
                 f"[{self.rule}] {self.message}")
 
     def to_json(self) -> dict:
-        return dataclasses.asdict(self)
+        """Machine-readable record (the CI job turns these into inline
+        PR annotations): file / line / col / rule / message."""
+        return {"file": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message}
 
 
 class Rule:
@@ -90,7 +96,41 @@ class Rule:
                        getattr(node, "col_offset", 0) + 1, message)
 
 
+class ProgramRule:
+    """A named check over a whole :class:`Program` (module set).
+
+    Per-file rules see one :class:`ModuleContext`; program rules see
+    them all — the concurrency pass (``concurrency.py``) is
+    interprocedural across ``apex_tpu/serving``, ``resilience`` and
+    ``utils/metrics`` and cannot work file-at-a-time.  Suppressions
+    still apply per finding line in the finding's own file.
+    """
+
+    name: str = ""
+    summary: str = ""
+    #: rules sharing one expensive analysis name it here: the runner
+    #: times :meth:`prepare` once under this row in ``--timings``, so
+    #: the cost is not charged to whichever rule happens to run first
+    shared_pass: str = ""
+
+    def prepare(self, program: "Program") -> None:
+        """Run/memoize any shared analysis on ``program`` (timed under
+        :attr:`shared_pass`); default no-op."""
+
+    def check_program(self, program: "Program") -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+class Program:
+    """The parsed module set one lint run covers."""
+
+    def __init__(self, contexts: List["ModuleContext"]):
+        self.contexts = list(contexts)
+        self.by_path = {ctx.path: ctx for ctx in self.contexts}
+
+
 _REGISTRY: Dict[str, Rule] = {}
+_PROGRAM_REGISTRY: Dict[str, ProgramRule] = {}
 
 
 def register(rule_cls: type) -> type:
@@ -98,16 +138,37 @@ def register(rule_cls: type) -> type:
     rule = rule_cls()
     if not rule.name:
         raise ValueError(f"{rule_cls.__name__} has no name")
-    if rule.name in _REGISTRY:
+    if rule.name in _REGISTRY or rule.name in _PROGRAM_REGISTRY:
         raise ValueError(f"duplicate rule name {rule.name!r}")
     _REGISTRY[rule.name] = rule
     return rule_cls
 
 
-def all_rules() -> Dict[str, Rule]:
-    # rules.py self-registers on import; import lazily to avoid a cycle
+def register_program(rule_cls: type) -> type:
+    """Class decorator adding a whole-program rule to the registry."""
+    rule = rule_cls()
+    if not rule.name:
+        raise ValueError(f"{rule_cls.__name__} has no name")
+    if rule.name in _REGISTRY or rule.name in _PROGRAM_REGISTRY:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    _PROGRAM_REGISTRY[rule.name] = rule
+    return rule_cls
+
+
+def _load_rule_modules() -> None:
+    # rules self-register on import; import lazily to avoid a cycle
+    from tools.graftlint import concurrency as _conc  # noqa: F401
     from tools.graftlint import rules as _rules  # noqa: F401
+
+
+def all_rules() -> Dict[str, Rule]:
+    _load_rule_modules()
     return dict(_REGISTRY)
+
+
+def all_program_rules() -> Dict[str, ProgramRule]:
+    _load_rule_modules()
+    return dict(_PROGRAM_REGISTRY)
 
 
 # ----------------------------------------------------------- suppressions
@@ -136,6 +197,12 @@ class _Suppressions:
         self.file_wide: Set[str] = set()
         self.traced_marks: Set[int] = set()
         self.not_traced_marks: Set[int] = set()
+        #: raw text of every `graftlint:` comment, by line — the
+        #: concurrency pass parses its annotation marks out of these
+        self.graftlint_comments: Dict[int, str] = {}
+        #: lines whose graftlint comment is standalone (whole-line):
+        #: only those may annotate the line below them
+        self.standalone_comment_lines: Set[int] = set()
 
     @classmethod
     def scan(cls, source: str) -> "_Suppressions":
@@ -148,6 +215,10 @@ class _Suppressions:
                     continue
                 text = tok.string.lstrip("#").strip()
                 line = tok.start[0]
+                if "graftlint:" in text:
+                    sup.graftlint_comments[line] = text
+                    if tok.line.strip().startswith("#"):
+                        sup.standalone_comment_lines.add(line)
                 standalone = tok.line.strip().startswith("#")
                 if text.startswith(_DISABLE_FILE):
                     sup.file_wide |= _parse_rule_list(
@@ -612,34 +683,138 @@ def taint_function(fn: ast.AST) -> Set[str]:
 
 # ---------------------------------------------------------------- running
 
-def lint_source(source: str, path: str = "<string>",
-                select: Optional[Iterable[str]] = None) -> List[Finding]:
-    """Lint python ``source``; returns unsuppressed findings."""
+#: stats of the most recent lint run (the --timings summary and the
+#: budget assertion in tests/test_graftlint.py read these)
+run_stats: Dict[str, object] = {
+    "files": 0, "parse_s": 0.0, "parse_count": 0, "cache_hits": 0,
+    "rules_s": {}, "total_s": 0.0,
+}
+
+#: parsed-context cache: path -> ((mtime_ns, size), ModuleContext).
+#: One parse feeds every per-file rule AND the whole-program pass —
+#: and repeated runs in one process (tests, editors) re-lint a file
+#: for free until it changes on disk.
+_context_cache: Dict[str, "tuple"] = {}
+
+
+def _build_context(source: str, path: str):
+    """Parse ``source`` into a ModuleContext, or a parse-error Finding."""
+    t0 = time.perf_counter()
+    run_stats["parse_count"] = int(run_stats["parse_count"]) + 1
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as exc:
-        return [Finding("parse-error", path, exc.lineno or 1,
-                        (exc.offset or 0) + 1,
-                        f"syntax error: {exc.msg}")]
+        run_stats["parse_s"] = float(run_stats["parse_s"]) \
+            + (time.perf_counter() - t0)
+        return None, Finding("parse-error", path, exc.lineno or 1,
+                             (exc.offset or 0) + 1,
+                             f"syntax error: {exc.msg}")
     ctx = ModuleContext(path, source, tree)
+    run_stats["parse_s"] = float(run_stats["parse_s"]) \
+        + (time.perf_counter() - t0)
+    return ctx, None
+
+
+def load_context(path: str):
+    """Cached parse of ``path`` → (ModuleContext | None, parse Finding
+    | None).  The cache key is (mtime_ns, size), so an edited file
+    reparses and an unchanged one is free."""
+    try:
+        st = os.stat(path)
+        sig = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        sig = None
+    if sig is not None:
+        hit = _context_cache.get(path)
+        if hit is not None and hit[0] == sig:
+            run_stats["cache_hits"] = int(run_stats["cache_hits"]) + 1
+            return hit[1], hit[2]
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    ctx, err = _build_context(source, path)
+    if sig is not None:
+        _context_cache[path] = (sig, ctx, err)
+    return ctx, err
+
+
+def _selected(select: Optional[Iterable[str]]):
     rules = all_rules()
-    names = set(select) if select else set(rules)
-    unknown = names - set(rules)
+    program_rules = all_program_rules()
+    names = set(select) if select else set(rules) | set(program_rules)
+    unknown = names - set(rules) - set(program_rules)
     if unknown:
         raise ValueError(f"unknown rule(s): {sorted(unknown)}")
-    findings: List[Finding] = []
-    for name in sorted(names):
-        for f in rules[name].check(ctx):
-            if not ctx.suppressions.is_suppressed(f.rule, f.line):
+    return ({n: rules[n] for n in names if n in rules},
+            {n: program_rules[n] for n in names if n in program_rules})
+
+
+def _timed(name: str, fn) -> List[Finding]:
+    t0 = time.perf_counter()
+    out = list(fn())
+    per_rule = run_stats["rules_s"]
+    per_rule[name] = per_rule.get(name, 0.0) \
+        + (time.perf_counter() - t0)
+    return out
+
+
+def _run_rules(contexts, parse_errors,
+               select: Optional[Iterable[str]]) -> List[Finding]:
+    file_rules, program_rules = _selected(select)
+    findings: List[Finding] = list(parse_errors)
+    for ctx in contexts:
+        for name in sorted(file_rules):
+            for f in _timed(name, lambda n=name, c=ctx:
+                            file_rules[n].check(c)):
+                if not ctx.suppressions.is_suppressed(f.rule, f.line):
+                    findings.append(f)
+    if program_rules and contexts:
+        program = Program(contexts)
+        prepared: Set[str] = set()
+        for name in sorted(program_rules):
+            shared = program_rules[name].shared_pass
+            if shared and shared not in prepared:
+                prepared.add(shared)
+                _timed(shared, lambda n=name: (
+                    program_rules[n].prepare(program), ())[1])
+            for f in _timed(name, lambda n=name:
+                            program_rules[n].check_program(program)):
+                ctx = program.by_path.get(f.path)
+                if ctx is not None and \
+                        ctx.suppressions.is_suppressed(f.rule, f.line):
+                    continue
                 findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
+def _reset_stats() -> None:
+    run_stats.update(files=0, parse_s=0.0, parse_count=0,
+                     cache_hits=0, rules_s={}, total_s=0.0)
+
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint python ``source``; returns unsuppressed findings.  The
+    single module is also treated as a whole program, so the
+    concurrency rules run on it (fixture-friendly)."""
+    _reset_stats()          # run_stats describes THIS run only
+    ctx, err = _build_context(source, path)
+    if ctx is None:
+        all_rules()          # still validate `select` names
+        all_program_rules()
+        if select:
+            _selected(select)
+        return [err]
+    return _run_rules([ctx], [], select)
+
+
 def lint_path(path: str,
               select: Optional[Iterable[str]] = None) -> List[Finding]:
-    with open(path, encoding="utf-8") as f:
-        return lint_source(f.read(), path, select)
+    _reset_stats()          # run_stats describes THIS run only
+    ctx, err = load_context(path)
+    if ctx is None:
+        return [err] if err is not None else []
+    return _run_rules([ctx], [], select)
 
 
 _SKIP_DIRS = {"__pycache__", "build", "dist", ".git", ".eggs",
@@ -665,16 +840,49 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
 
 def lint_paths(paths: Iterable[str],
                select: Optional[Iterable[str]] = None) -> List[Finding]:
-    findings: List[Finding] = []
+    """Lint files/trees: per-file rules on each module, then the
+    whole-program rules over the full module set (one parse per file
+    feeds both — see :func:`load_context`)."""
+    _reset_stats()
+    t0 = time.perf_counter()
+    contexts = []
+    parse_errors: List[Finding] = []
+    n_files = 0
     for path in iter_python_files(paths):
-        findings.extend(lint_path(path, select))
+        n_files += 1
+        ctx, err = load_context(path)
+        if ctx is not None:
+            contexts.append(ctx)
+        elif err is not None:
+            parse_errors.append(err)
+    findings = _run_rules(contexts, parse_errors, select)
+    run_stats["files"] = n_files
+    run_stats["total_s"] = time.perf_counter() - t0
     return findings
+
+
+def _timing_summary(detail: bool = False) -> str:
+    per_rule = dict(run_stats["rules_s"])
+    rules_s = sum(per_rule.values())
+    line = (f"timing: {run_stats['total_s']:.2f}s total "
+            f"(parse {run_stats['parse_s']:.2f}s over "
+            f"{run_stats['parse_count']} parse(s), "
+            f"{run_stats['cache_hits']} cache hit(s); "
+            f"rules {rules_s:.2f}s)")
+    if detail and per_rule:
+        rows = sorted(per_rule.items(), key=lambda kv: -kv[1])
+        line += "".join(f"\n  {name:28s} {secs * 1e3:8.1f} ms"
+                        for name, secs in rows)
+    elif per_rule:
+        slowest = max(per_rule.items(), key=lambda kv: kv[1])
+        line += f"; slowest rule {slowest[0]} {slowest[1]:.2f}s"
+    return line
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="graftlint",
-        description="JAX trace-hygiene static analyzer "
+        description="JAX trace-hygiene + concurrency static analyzer "
                     "(see docs/graftlint.md)")
     parser.add_argument("paths", nargs="*", default=["apex_tpu"],
                         help="files or directories to lint")
@@ -685,18 +893,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="run only these rules (repeatable)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule registry and exit")
+    parser.add_argument("--timings", action="store_true",
+                        help="print the per-rule timing table")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for name, rule in sorted(all_rules().items()):
             print(f"{name:26s} {rule.summary}")
+        for name, rule in sorted(all_program_rules().items()):
+            print(f"{name:26s} [program] {rule.summary}")
         return 0
 
     try:
-        files = list(iter_python_files(args.paths))
-        findings = []
-        for path in files:
-            findings.extend(lint_path(path, args.select))
+        findings = lint_paths(args.paths, args.select)
     except (FileNotFoundError, ValueError) as exc:
         print(f"graftlint: error: {exc}", file=sys.stderr)
         return 2
@@ -708,5 +917,6 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f.render())
         status = (f"{len(findings)} finding(s)" if findings
                   else "clean")
-        print(f"graftlint: {len(files)} file(s), {status}")
+        print(f"graftlint: {run_stats['files']} file(s), {status}")
+        print(f"graftlint: {_timing_summary(detail=args.timings)}")
     return 1 if findings else 0
