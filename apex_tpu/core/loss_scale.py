@@ -21,6 +21,7 @@ from typing import Any, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.utils.metrics import counters as _counters
 from apex_tpu.utils.tree import tree_scale, tree_select
 
 __all__ = [
@@ -73,6 +74,31 @@ def all_finite(tree: Any) -> jnp.ndarray:
     return jnp.stack(finite).all()
 
 
+def _count_scale_events(grew, backed) -> None:
+    """Host sink for the scaler's growth/backoff events (fired from
+    inside the jitted step via ``jax.debug.callback``).  Counting on
+    :data:`apex_tpu.utils.metrics.counters` gives health probes, bench
+    emissions and :mod:`apex_tpu.utils.numcheck` one shared view of how
+    often the scale moved — a backoff burst correlating with a loss
+    excursion is the classic fp16 overflow signature.
+
+    ``growth`` counts only steps where the scale actually increased
+    (pinned-at-``max_scale`` growth triggers are NOT events — a healthy
+    long run would otherwise log a fake growth every interval forever);
+    ``backoff`` counts every skipped (non-finite) step, including at
+    the ``min_scale`` pin — the step WAS skipped, which is what the
+    counter promises ("skip/backoff counts").  SPMD caveat: a callback
+    inside ``pmap``/``shard_map`` (or a multi-device jit) fires once
+    per device, so replicated steps count each logical event
+    ``n_devices`` times — normalize by the replica count when reading
+    from a replicated step, or construct the scaler with
+    ``count_events=False`` there."""
+    if bool(grew):
+        _counters.inc("amp.loss_scale.growth")
+    if bool(backed):
+        _counters.inc("amp.loss_scale.backoff")
+
+
 @dataclasses.dataclass(frozen=True)
 class DynamicLossScale:
     """Dynamic loss scaling manager (apex defaults: 2**16 init, x2/÷2, 2000).
@@ -93,6 +119,11 @@ class DynamicLossScale:
     growth_interval: int = 2000
     max_scale: float = 2.0 ** 24
     min_scale: float = 1.0
+    #: count growth/backoff events on ``utils.metrics.counters`` (one
+    #: tiny async host callback per :meth:`adjust`).  Turn off for
+    #: wall-clock-pure benches or replicated (pmap/shard_map) steps
+    #: where per-device callback firing would multiply the counts.
+    count_events: bool = True
 
     def init(self) -> LossScaleState:
         return LossScaleState(
@@ -134,6 +165,17 @@ class DynamicLossScale:
             jnp.maximum(state.loss_scale * self.backoff_factor,
                         self.min_scale),
         )
+        if self.count_events:
+            # event counters (amp.loss_scale.growth / .backoff):
+            # shipped to the host asynchronously — scalars only, no
+            # device sync; the state machine itself stays pure.
+            # Growth only when the scale actually moved (max_scale pin
+            # is not an event); backoff on every skipped step.
+            grew = jnp.logical_and(
+                jnp.logical_and(grads_finite, grow),
+                new_scale != state.loss_scale)
+            jax.debug.callback(_count_scale_events, grew,
+                               jnp.logical_not(grads_finite))
         tracker = jnp.where(grow, 0, tracker)
         return LossScaleState(loss_scale=new_scale.astype(jnp.float32),
                               growth_tracker=tracker.astype(jnp.int32))

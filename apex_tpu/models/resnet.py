@@ -204,7 +204,10 @@ class ResNet(nn.Module):
                     cfg, cfg.width * (2 ** i),
                     stride=2 if (j == 0 and i > 0) else 1,
                     train=train, name=f"stage{i}_block{j}")(x)
-        x = jnp.mean(x, axis=(1, 2))
+        # global average pool accumulates in fp32: under a half policy
+        # x follows cfg.dtype, and a bf16 running sum over the spatial
+        # grid loses low bits before the (already-fp32) classifier
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         x = nn.Dense(cfg.num_classes, dtype=jnp.float32,
                      param_dtype=cfg.param_dtype, name="fc")(x)
         return x

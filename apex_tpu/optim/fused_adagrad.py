@@ -39,6 +39,7 @@ def fused_adagrad(
                                         dtype=jnp.float32), params),
         )
 
+    # graftlint: precision(master-fp32)
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_adagrad requires params")

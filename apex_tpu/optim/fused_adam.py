@@ -255,7 +255,11 @@ def fused_adam(
             exp_avg_sq=jax.tree.map(zeros, params),
         )
 
+    # graftlint: precision(master-fp32)
     def update(grads, state, params=None):
+        # under O2 `params` are the fp32 masters held by
+        # MixedPrecisionTrainState — the update must never consume the
+        # half forward-pass copy (the mark makes call sites checkable)
         if params is None:
             raise ValueError("fused_adam requires params")
         count = state.count + 1

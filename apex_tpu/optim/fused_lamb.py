@@ -63,6 +63,7 @@ def fused_lamb(
             exp_avg_sq=jax.tree.map(zeros, params),
         )
 
+    # graftlint: precision(master-fp32)
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_lamb requires params")

@@ -53,6 +53,7 @@ def fused_mixed_precision_lamb(
         masters = jax.tree.map(_to_master, params)
         return FusedMixedPrecisionLambState(masters, inner.init(masters))
 
+    # graftlint: precision(master-fp32)
     def update(grads, state, params=None):
         if params is None:
             raise ValueError(

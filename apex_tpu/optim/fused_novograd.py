@@ -50,6 +50,7 @@ def fused_novograd(
                 lambda p: jnp.zeros((), jnp.float32), params),
         )
 
+    # graftlint: precision(master-fp32)
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_novograd requires params")

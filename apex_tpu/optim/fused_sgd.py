@@ -44,6 +44,7 @@ def fused_sgd(
             momentum_buf=jax.tree.map(jnp.zeros_like, params),
         )
 
+    # graftlint: precision(master-fp32)
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_sgd requires params")

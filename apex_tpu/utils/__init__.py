@@ -25,6 +25,7 @@ from apex_tpu.utils.tracecheck import (
     reset_trace_event_count,
 )
 from apex_tpu.utils import lockcheck
+from apex_tpu.utils import numcheck
 
 __all__ = [
     "is_floating",
@@ -44,4 +45,5 @@ __all__ = [
     "RetraceError", "retrace_guard", "trace_event_count",
     "reset_trace_event_count",
     "lockcheck",
+    "numcheck",
 ]

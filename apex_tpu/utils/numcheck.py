@@ -1,0 +1,406 @@
+"""Runtime numerics sanitizer — the dynamic oracle behind graftlint's
+precision pass.
+
+``tools/graftlint``'s dtype-flow rules catch mixed-precision bugs
+*statically*: bf16-accumulated reductions, optimizer updates landing on
+non-fp32 masters, grad clipping on still-scaled grads (see
+``docs/graftlint.md``).  This module is the matching *runtime*
+tripwire, the way :mod:`apex_tpu.utils.lockcheck` backs the
+concurrency rules: :func:`instrument` hooks the amp cast boundaries
+(:meth:`PrecisionPolicy.cast_to_param` / ``cast_to_compute`` /
+``cast_to_output``), the loss-scale path
+(:meth:`DynamicLossScale.scale` / ``unscale``) and the optimizer step
+(:meth:`MixedPrecisionTrainState.apply_gradients`) and records, per
+site:
+
+- **dtype histograms** — how many floating leaves of each dtype
+  crossed the site.  Dtypes are static metadata, so these are counted
+  at *trace* time (once per compiled variant, which is exactly the
+  surface the static pass models) and work on tracers and concrete
+  arrays alike.
+- **non-finite counts** — elements that are inf/NaN in the (un)scaled
+  grads, and grads-step counts where any appeared.  Occasional
+  non-finite *scaled* grads are the dynamic loss scaler's expected
+  diet (that is what skip-and-backoff is for), so they are counted,
+  never flagged.
+- **grad underflow-to-zero fraction** — the fraction of grad elements
+  that are exactly zero at the optimizer step.  A rising fraction with
+  a falling loss scale is the classic fp16 underflow signature; the
+  counters ``numcheck.grad_zero`` / ``numcheck.grad_total`` land on
+  :data:`apex_tpu.utils.metrics.counters` beside the
+  ``amp.loss_scale.growth`` / ``amp.loss_scale.backoff`` events the
+  scaler itself now counts, so bench emissions and loss-trajectory
+  tests can correlate precision events with divergence.
+
+Violations (strict mode; recorded, never raised at the fault site —
+``assert_clean()`` raises at soak end, the lockcheck contract):
+
+- **master-weight violation** (static twin: ``master-weight-violation``)
+  — ``apply_gradients`` on a state whose policy demands fp32 masters
+  (``master_weights=True``) while a floating param leaf is not fp32.
+  Checked at trace time, so every compiled variant is covered.
+- **downcast overflow** (static twin: ``redundant-cast`` /
+  ``bf16-unsafe-reduction`` territory) — a cast boundary turning
+  finite fp32 values into non-finite fp16 (bf16 shares fp32's
+  exponent range and cannot overflow this way).
+- **non-finite params after the step** — ``apply_gradients``
+  guarantees params stay finite via its ``where(finite, new, old)``
+  select; a non-finite param leaf escaping it means the skip
+  machinery was bypassed.
+
+Usage (the chaos soaks)::
+
+    from apex_tpu.utils import numcheck
+
+    numcheck.reset()
+    numcheck.instrument(strict=True)     # BEFORE the first jit trace
+    ... run the soak ...
+    jax.effects_barrier()                # land in-flight stat callbacks
+    numcheck.assert_clean()              # zero recorded violations
+    numcheck.uninstrument()
+
+The chaos-smoke CI job exports ``APEX_TPU_NUMCHECK=strict``;
+``instrument()`` with no explicit ``strict=`` follows that env
+(default non-strict: observe-only — histograms, counters, no
+violations).  Instrumentation is process-wide (it wraps class methods,
+not instances — the surfaces are pure pytree functions, not stateful
+objects like the lock sanitizer's targets) and idempotent;
+``uninstrument()`` restores the originals.  Instrument **before**
+tracing: wrappers add their device-side stat emissions at trace time,
+so a function compiled earlier keeps running uninstrumented.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.metrics import counters
+
+__all__ = [
+    "NumCheckError",
+    "instrument",
+    "uninstrument",
+    "env_strict",
+    "reports",
+    "reset",
+    "assert_clean",
+    "summary",
+    "site_histograms",
+]
+
+_ENV = "APEX_TPU_NUMCHECK"
+
+
+class NumCheckError(AssertionError):
+    """Raised by :func:`assert_clean` when the sanitizer has reports."""
+
+
+def env_strict() -> bool:
+    """True when ``APEX_TPU_NUMCHECK=strict`` (the chaos-smoke CI
+    job's setting)."""
+    return os.environ.get(_ENV, "").strip().lower() == "strict"
+
+
+# ---------------------------------------------------------------- recorder
+
+class _Recorder:
+    """Process-wide stats + violation log (one lock, tiny sections)."""
+
+    def __init__(self):
+        self._mutex = threading.Lock()
+        # site -> dtype name -> floating-leaf count (trace-time)
+        self.sites: Dict[str, Dict[str, int]] = {}
+        self.grad_zero = 0
+        self.grad_total = 0
+        self.nonfinite_grad_elems = 0
+        self.nonfinite_grad_steps = 0
+        self.grad_stat_steps = 0
+        self.violations: List[str] = []
+        self._reported: set = set()
+
+    def record_dtypes(self, site: str, tree: Any) -> None:
+        hist: Dict[str, int] = {}
+        for leaf in jax.tree.leaves(tree):
+            dt = getattr(leaf, "dtype", None)
+            if dt is None or not jnp.issubdtype(dt, jnp.floating):
+                continue
+            name = jnp.dtype(dt).name
+            hist[name] = hist.get(name, 0) + 1
+        if not hist:
+            return
+        with self._mutex:
+            dest = self.sites.setdefault(site, {})
+            for name, n in hist.items():
+                dest[name] = dest.get(name, 0) + n
+
+    def report(self, key: Tuple, message: str) -> None:
+        # one report per distinct site — a soak loop hitting the same
+        # breach a thousand times is one finding
+        with self._mutex:
+            if key in self._reported:
+                return
+            self._reported.add(key)
+            self.violations.append(message)
+
+
+_recorder = _Recorder()
+_strict = False
+_instrumented = False
+#: (owner class/obj, attr name, original function)
+_originals: List[Tuple[Any, str, Any]] = []
+
+
+def reports() -> List[str]:
+    """Every violation recorded since the last :func:`reset`."""
+    with _recorder._mutex:
+        return list(_recorder.violations)
+
+
+def reset() -> None:
+    """Clear histograms, stats and the violation log (test isolation).
+    Instrumentation, if installed, keeps recording into fresh state."""
+    with _recorder._mutex:
+        _recorder.sites.clear()
+        _recorder.grad_zero = 0
+        _recorder.grad_total = 0
+        _recorder.nonfinite_grad_elems = 0
+        _recorder.nonfinite_grad_steps = 0
+        _recorder.grad_stat_steps = 0
+        _recorder.violations.clear()
+        _recorder._reported.clear()
+
+
+def assert_clean() -> None:
+    """Raise :class:`NumCheckError` listing every recorded violation
+    (no-op when clean) — the soak's closing assertion.  Call
+    ``jax.effects_barrier()`` first so in-flight stat callbacks land."""
+    found = reports()
+    if found:
+        listing = "\n  ".join(found)
+        raise NumCheckError(
+            f"numcheck: {len(found)} violation(s):\n  {listing}")
+
+
+def site_histograms() -> Dict[str, Dict[str, int]]:
+    """Per-site dtype histograms (floating leaves per dtype, counted
+    at trace time — once per compiled variant)."""
+    with _recorder._mutex:
+        return {site: dict(hist)
+                for site, hist in _recorder.sites.items()}
+
+
+def summary() -> Dict[str, Any]:
+    """One-shot numerics summary for bench emissions / soak reports:
+    grad underflow-to-zero fraction, non-finite counts, loss-scale
+    growth/backoff event counts (read from the same
+    :data:`~apex_tpu.utils.metrics.counters` the scaler writes), and
+    the per-site dtype histograms."""
+    with _recorder._mutex:
+        total = _recorder.grad_total
+        out = {
+            "grad_underflow_frac": (
+                _recorder.grad_zero / total if total else 0.0),
+            "grad_zero_elems": _recorder.grad_zero,
+            "grad_total_elems": total,
+            "nonfinite_grad_elems": _recorder.nonfinite_grad_elems,
+            "nonfinite_grad_steps": _recorder.nonfinite_grad_steps,
+            "grad_stat_steps": _recorder.grad_stat_steps,
+            "violations": len(_recorder.violations),
+            "sites": {s: dict(h) for s, h in _recorder.sites.items()},
+        }
+    out["loss_scale_growth"] = counters.get("amp.loss_scale.growth")
+    out["loss_scale_backoff"] = counters.get("amp.loss_scale.backoff")
+    return out
+
+
+# ------------------------------------------------------ device-side stats
+
+def _float_leaves(tree: Any) -> List[Any]:
+    return [l for l in jax.tree.leaves(tree)
+            if hasattr(l, "dtype")
+            and jnp.issubdtype(l.dtype, jnp.floating)]
+
+
+def _on_grad_stats(zero, total, nonfinite) -> None:
+    """Host sink for the per-step grad stats (runs via
+    ``jax.debug.callback``, possibly long after the step launched)."""
+    zero = int(zero)
+    total = int(total)
+    nonfinite = int(nonfinite)
+    with _recorder._mutex:
+        _recorder.grad_zero += zero
+        _recorder.grad_total += total
+        _recorder.nonfinite_grad_elems += nonfinite
+        _recorder.grad_stat_steps += 1
+        if nonfinite:
+            _recorder.nonfinite_grad_steps += 1
+    counters.inc("numcheck.grad_zero", zero)
+    counters.inc("numcheck.grad_total", total)
+    if nonfinite:
+        counters.inc("numcheck.nonfinite_grads")
+
+
+def _emit_grad_stats(grads: Any) -> None:
+    # counts ride as float32: int32 would wrap at 2^31 grad elements
+    # (squarely in range for the billion-parameter models this library
+    # targets) and int64 needs x64 mode; fp32's 2^24 exact-integer
+    # limit only blurs the *fraction*'s low bits, which is fine
+    leaves = _float_leaves(grads)
+    if not leaves:
+        return
+    zero = sum(jnp.sum(l == 0, dtype=jnp.float32) for l in leaves)
+    total = jnp.asarray(float(sum(int(l.size) for l in leaves)),
+                        jnp.float32)
+    nonfinite = sum(jnp.sum(~jnp.isfinite(l), dtype=jnp.float32)
+                    for l in leaves)
+    jax.debug.callback(_on_grad_stats, zero, total, nonfinite)
+
+
+def _on_overflow(site: str, count) -> None:
+    count = int(count)
+    if count and _strict:
+        _recorder.report(
+            ("overflow", site),
+            f"downcast overflow at {site}: {count} element(s) were "
+            f"finite before the cast and non-finite after — fp16 "
+            f"cannot hold the value; keep it fp32 or use bf16 "
+            f"(static twin: the precision pass's cast discipline)")
+
+
+def _emit_downcast_overflow(site: str, before: Any, after: Any) -> None:
+    pairs = []
+    for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        if not (hasattr(a, "dtype") and hasattr(b, "dtype")):
+            continue
+        if jnp.dtype(a.dtype) == jnp.float16 \
+                and jnp.issubdtype(b.dtype, jnp.floating) \
+                and jnp.dtype(b.dtype).itemsize > 2:
+            pairs.append((b, a))
+    if not pairs:
+        return
+    count = sum(jnp.sum(jnp.isfinite(b) & ~jnp.isfinite(a),
+                        dtype=jnp.float32) for b, a in pairs)
+    jax.debug.callback(lambda c, s=site: _on_overflow(s, c), count)
+
+
+def _on_nonfinite_params(count) -> None:
+    count = int(count)
+    if count and _strict:
+        _recorder.report(
+            ("params-nonfinite",),
+            f"non-finite params after apply_gradients: {count} "
+            f"element(s) — the step's where(finite, new, old) select "
+            f"should have kept the old values; the skip machinery was "
+            f"bypassed (custom optimizer writing around the select?)")
+
+
+# --------------------------------------------------------------- wrappers
+
+def _wrap(owner: Any, name: str, make_wrapper) -> None:
+    orig = getattr(owner, name)
+    if getattr(orig, "_numcheck_wrapper", False):
+        return
+    wrapper = make_wrapper(orig)
+    wrapper._numcheck_wrapper = True
+    wrapper.__name__ = getattr(orig, "__name__", name)
+    wrapper.__doc__ = getattr(orig, "__doc__", None)
+    _originals.append((owner, name, orig))
+    setattr(owner, name, wrapper)
+
+
+def _cast_wrapper(site: str, orig):
+    def wrapped(self, tree, *args, **kwargs):
+        out = orig(self, tree, *args, **kwargs)
+        _recorder.record_dtypes(f"{site}.in", tree)
+        _recorder.record_dtypes(f"{site}.out", out)
+        _emit_downcast_overflow(site, tree, out)
+        return out
+    return wrapped
+
+
+def _scale_wrapper(orig):
+    def wrapped(self, state, loss):
+        out = orig(self, state, loss)
+        _recorder.record_dtypes("loss_scale.scale.in", loss)
+        _recorder.record_dtypes("loss_scale.scale.out", out)
+        return out
+    return wrapped
+
+
+def _unscale_wrapper(orig):
+    def wrapped(self, state, grads):
+        out = orig(self, state, grads)
+        _recorder.record_dtypes("loss_scale.unscale.grads", grads)
+        return out
+    return wrapped
+
+
+def _apply_gradients_wrapper(orig):
+    def wrapped(self, *, grads, **kwargs):
+        _recorder.record_dtypes("apply_gradients.grads", grads)
+        _recorder.record_dtypes("apply_gradients.params", self.params)
+        if _strict and self.policy.master_weights:
+            bad = sorted({
+                jnp.dtype(l.dtype).name for l in _float_leaves(self.params)
+                if jnp.dtype(l.dtype) != jnp.float32})
+            if bad:
+                _recorder.report(
+                    ("master", tuple(bad)),
+                    f"optimizer step on non-fp32 master weights: the "
+                    f"policy ({self.policy.opt_level}) holds fp32 "
+                    f"masters but param leaves are {bad} — the update "
+                    f"quantizes to the storage dtype and every "
+                    f"increment below its precision is lost (static "
+                    f"twin: master-weight-violation)")
+        _emit_grad_stats(grads)
+        new_state, finite = orig(self, grads=grads, **kwargs)
+        leaves = _float_leaves(new_state.params)
+        if leaves:
+            count = sum(jnp.sum(~jnp.isfinite(l), dtype=jnp.float32)
+                        for l in leaves)
+            jax.debug.callback(_on_nonfinite_params, count)
+        return new_state, finite
+    return wrapped
+
+
+def instrument(*, strict: Optional[bool] = None) -> None:
+    """Install the numerics hooks process-wide (idempotent).
+
+    ``strict=None`` follows ``APEX_TPU_NUMCHECK=strict`` (the
+    chaos-smoke CI setting); pass ``strict=True`` to force violation
+    recording, ``strict=False`` for observe-only.  Call **before** the
+    first jit trace of the train step: the hooks add their device-side
+    stat emissions when the step is traced.
+    """
+    global _strict, _instrumented
+    _strict = env_strict() if strict is None else bool(strict)
+    if _instrumented:
+        return
+    from apex_tpu.core.loss_scale import DynamicLossScale
+    from apex_tpu.core.precision import PrecisionPolicy
+    from apex_tpu.core.train_state import MixedPrecisionTrainState
+
+    for site in ("cast_to_param", "cast_to_compute", "cast_to_output"):
+        _wrap(PrecisionPolicy, site,
+              lambda orig, s=site: _cast_wrapper(s, orig))
+    _wrap(DynamicLossScale, "scale", _scale_wrapper)
+    _wrap(DynamicLossScale, "unscale", _unscale_wrapper)
+    _wrap(MixedPrecisionTrainState, "apply_gradients",
+          _apply_gradients_wrapper)
+    _instrumented = True
+
+
+def uninstrument() -> None:
+    """Restore every wrapped method (recorded stats survive until
+    :func:`reset`).  Already-compiled functions keep the wrappers they
+    were traced with — re-jit after uninstrumenting to shed them."""
+    global _instrumented
+    while _originals:
+        owner, name, orig = _originals.pop()
+        setattr(owner, name, orig)
+    _instrumented = False

@@ -1303,6 +1303,43 @@ def bench_bert_o1():
     virtual-CPU-mesh DDP A/B of ``allreduce_dtype`` None vs ``"int8"``
     on a layer-shrunk proxy (BENCH_BERT_DDP=0 skips it; on-chip, run
     the child leg directly on the real mesh)."""
+    from apex_tpu.utils import numcheck
+    from apex_tpu.utils.metrics import counters as _counters
+
+    # ISSUE-10 satellite: the leg rides the runtime numerics sanitizer
+    # in observe mode — the emission carries the grad underflow-to-zero
+    # fraction and the loss-scale growth/backoff event counts, so the
+    # loss-trajectory band tests can correlate precision events with
+    # divergence.  One scalar reduction + async callback per step;
+    # BENCH_NUMCHECK=0 opts out for on-chip wall-clock purity.
+    observe_numerics = os.environ.get("BENCH_NUMCHECK", "1") != "0"
+    if observe_numerics:
+        numcheck.reset()
+        numcheck.instrument(strict=False)
+    events_before = _counters.snapshot()
+    try:
+        out = _bench_bert_o1_measured(observe_numerics, events_before)
+    finally:
+        # the wrappers are process-wide: never leak them into later
+        # legs run in this process if the measurement raises
+        if observe_numerics:
+            numcheck.uninstrument()
+    if os.environ.get("BENCH_BERT_DDP", "1") != "0":
+        # measured companion: 8-way virtual-CPU-mesh DDP A/B of
+        # allreduce_dtype None vs "int8" on a layer-shrunk proxy
+        out["ddp_int8_ab"] = _run_child("bert_o1_ddp", {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": None,
+            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device"
+                            "_count=8").strip(),
+        }, timeout=1500)
+    _emit(out)
+
+
+def _bench_bert_o1_measured(observe_numerics, events_before):
+    """The measured body of :func:`bench_bert_o1` (split out so the
+    numcheck instrumentation wraps it in one try/finally)."""
     import jax
     import jax.numpy as jnp
 
@@ -1310,6 +1347,8 @@ def bench_bert_o1():
     from apex_tpu.amp import o1
     from apex_tpu.models import BertConfig, BertModel, bert_mlm_loss_fn
     from apex_tpu.optim import fused_adam
+    from apex_tpu.utils import numcheck
+    from apex_tpu.utils.metrics import counters as _counters
 
     b = int(os.environ.get("BENCH_BATCH", "16"))
     cfg = BertConfig.bert_large(remat=True, dtype=None, scan_layers=False)
@@ -1351,17 +1390,23 @@ def bench_bert_o1():
     # ISSUE-8 / ROADMAP 2b: what the grad sync of THIS model costs on
     # the wire per step, fp32 vs bf16 vs the ddp.py int8 path
     out["ddp_bytes_on_wire"] = _ddp_bytes_on_wire(n_params, replicas)
-    if os.environ.get("BENCH_BERT_DDP", "1") != "0":
-        # measured companion: 8-way virtual-CPU-mesh DDP A/B of
-        # allreduce_dtype None vs "int8" on a layer-shrunk proxy
-        out["ddp_int8_ab"] = _run_child("bert_o1_ddp", {
-            "JAX_PLATFORMS": "cpu",
-            "PALLAS_AXON_POOL_IPS": None,
-            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
-                          + " --xla_force_host_platform_device"
-                            "_count=8").strip(),
-        }, timeout=1500)
-    _emit(out)
+    # ISSUE-10: precision-event telemetry beside the throughput number
+    if observe_numerics:
+        jax.effects_barrier()
+        stats = numcheck.summary()
+        after = _counters.snapshot()
+        out["numcheck"] = {
+            "grad_underflow_frac": round(
+                stats["grad_underflow_frac"], 6),
+            "nonfinite_grad_steps": stats["nonfinite_grad_steps"],
+            "loss_scale_growth": (
+                after.get("amp.loss_scale.growth", 0)
+                - events_before.get("amp.loss_scale.growth", 0)),
+            "loss_scale_backoff": (
+                after.get("amp.loss_scale.backoff", 0)
+                - events_before.get("amp.loss_scale.backoff", 0)),
+        }
+    return out
 
 
 def bench_bert_o1_ddp():

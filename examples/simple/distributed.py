@@ -70,7 +70,11 @@ def main():
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, x, y):
         def loss_fn(p):
-            return jnp.mean((state.apply_fn(p, x) - y) ** 2)
+            # loss reduction anchored in fp32 (the convention every
+            # model loss here follows): under a half-dtype net the
+            # MSE mean would otherwise accumulate in bf16
+            pred = state.apply_fn(p, x).astype(jnp.float32)
+            return jnp.mean((pred - y) ** 2)
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         new_state, _ = state.apply_gradients(grads=grads)
         return new_state, loss

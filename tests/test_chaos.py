@@ -32,6 +32,17 @@ declared lock — the soak asserts zero reports at the end.  The
 chaos-smoke CI job exports ``APEX_TPU_LOCKCHECK=strict`` to document
 the mode; the soaks force ``strict=True`` regardless.
 
+The training soaks additionally run under the **strict runtime
+numerics sanitizer** (``apex_tpu.utils.numcheck``, ISSUE 10 — the
+precision pass's dynamic twin, same mold): the amp cast boundaries,
+loss-scale path and optimizer step are hooked, grad underflow /
+non-finite stats recorded, and the soak asserts zero numerics
+violations at the end.  ``TestMixedPrecisionBenchSmoke`` is the bench
+leg's chaos twin: the BERT-bench O2 recipe at toy size, with a planted
+overflow step proving skip/backoff fires (and is *counted*) without a
+violation.  The chaos-smoke CI job exports ``APEX_TPU_NUMCHECK=strict``
+to document the mode; the soaks force ``strict=True`` regardless.
+
 CI runs these in the dedicated ``chaos-smoke`` job (small configs,
 CPU).  They carry ``slow`` too: the tier-1 ``-m 'not slow'`` gate
 already rides its wall-clock budget, and these three dots cost ~a
@@ -60,7 +71,7 @@ from apex_tpu.resilience import (
 )
 from apex_tpu.serving import FleetRouter, InferenceServer, RequestFailed
 from apex_tpu.transformer.testing import standalone_gpt
-from apex_tpu.utils import MetricsWriter, lockcheck, tracecheck
+from apex_tpu.utils import MetricsWriter, lockcheck, numcheck, tracecheck
 
 pytestmark = [pytest.mark.chaos, pytest.mark.slow]
 
@@ -69,6 +80,18 @@ class TestKillAndResumeTrajectory:
     STEPS = 40
     B, S = 4, 16
     CKPT_EVERY = 8
+
+    @pytest.fixture(autouse=True)
+    def _numcheck_strict(self):
+        # ISSUE-10: the GPT soak runs under the strict runtime
+        # numerics sanitizer — installed before the first jit trace so
+        # the hooks ride the compiled step; torn down even on failure
+        # so the process-wide wrappers never leak into other tests
+        numcheck.reset()
+        numcheck.instrument(strict=True)
+        yield
+        numcheck.uninstrument()
+        numcheck.reset()
 
     def _make(self):
         model, init_params = standalone_gpt(seed=0, max_seq_len=self.S)
@@ -185,6 +208,104 @@ class TestKillAndResumeTrajectory:
         for i in overlap:
             np.testing.assert_allclose(rows2[i], rows1[i], rtol=0,
                                        atol=1e-5)
+
+        # ------------------- zero numerics violations across the soak
+        # (kill, corrupt-checkpoint fallback and resume included) —
+        # and the sanitizer demonstrably observed the optimizer steps
+        jax.effects_barrier()
+        numcheck.assert_clean()
+        assert numcheck.summary()["grad_stat_steps"] > 0
+
+
+class TestMixedPrecisionBenchSmoke:
+    """ISSUE-10 bench-smoke twin: the bench BERT leg's mixed-precision
+    recipe (O2 + FusedAdam + ``scale_loss`` + ``apply_gradients``) at
+    toy size, under the strict runtime numerics sanitizer — with a
+    deliberately planted fp16 overflow step proving that the dynamic
+    loss scaler's skip/backoff path fires, is *counted* on the shared
+    ``amp.loss_scale.*`` counters (the bench emission's source), and is
+    NOT a numerics violation; the trajectory keeps training through it.
+    """
+
+    STEPS = 18
+    B, S = 4, 16
+
+    def test_o2_fp16_smoke_strict_numcheck_clean(self):
+        from apex_tpu.core.loss_scale import DynamicLossScale
+        from apex_tpu.transformer.testing import standalone_gpt
+        from apex_tpu.utils.metrics import counters
+
+        numcheck.reset()
+        numcheck.instrument(strict=True)
+        try:
+            model, init_params = standalone_gpt(seed=0, max_seq_len=self.S)
+            vocab = model.cfg.vocab_size
+            ids = jax.random.randint(
+                jax.random.PRNGKey(7), (4, self.B, self.S + 1), 0,
+                vocab, jnp.int32)
+
+            state = amp.initialize(
+                model.apply, {"params": init_params}, fused_adam(3e-4),
+                opt_level="O2", half_dtype=jnp.float16)
+            # short growth interval so the soak exercises growth too
+            ls = DynamicLossScale(growth_interval=4)
+            state = state.replace(loss_scaler=ls,
+                                  loss_scale_state=ls.init())
+
+            @jax.jit
+            def step(state, chunk, boost):
+                inputs, labels = chunk[:, :-1], chunk[:, 1:]
+
+                def loss_fn(p):
+                    logits = state.apply_fn(p, inputs)
+                    loss = gpt_loss_fn(logits.astype(jnp.float32),
+                                       labels)
+                    # `boost` plants a deterministic overflow: at the
+                    # poisoned step the scaled loss (and so the fp16
+                    # grads) goes inf, driving the skip/backoff path
+                    return state.scale_loss(loss * boost), loss
+
+                grads, loss = jax.grad(loss_fn, has_aux=True)(
+                    state.compute_params())
+                new_state, finite = state.apply_gradients(grads=grads)
+                return new_state, loss, finite
+
+            g0 = counters.get("amp.loss_scale.growth")
+            b0 = counters.get("amp.loss_scale.backoff")
+            overflow_at = 9
+            losses, finites = [], []
+            for i in range(self.STEPS):
+                boost = jnp.asarray(
+                    1e30 if i == overflow_at else 1.0, jnp.float32)
+                state, loss, finite = step(state, ids[i % 4], boost)
+                losses.append(float(loss))
+                finites.append(bool(finite))
+            jax.effects_barrier()
+
+            # the planted overflow skipped exactly its own step...
+            assert not finites[overflow_at]
+            assert all(f for i, f in enumerate(finites)
+                       if i != overflow_at)
+            # ...was counted as a backoff (and clean runs as growth)
+            assert counters.get("amp.loss_scale.backoff") == b0 + 1
+            assert counters.get("amp.loss_scale.growth") > g0
+            # the un-boosted losses stayed finite and it still trains
+            assert np.all(np.isfinite(losses))
+            assert losses[-1] < losses[0]
+
+            # strict sanitizer: the overflow is diet, not a violation;
+            # masters stayed fp32 through every step
+            numcheck.assert_clean()
+            s = numcheck.summary()
+            assert s["grad_stat_steps"] == self.STEPS
+            assert s["nonfinite_grad_steps"] == 1
+            assert s["sites"]["apply_gradients.params"] \
+                == {"float32": s["sites"]["apply_gradients.params"]
+                    .get("float32", 0)}   # fp32 masters only
+            assert "float16" in s["sites"]["apply_gradients.grads"]
+        finally:
+            numcheck.uninstrument()
+            numcheck.reset()
 
 
 class TestServingChaosSoak:

@@ -48,7 +48,9 @@ def test_registry_has_at_least_eight_rules():
 def test_program_registry_has_the_concurrency_rules():
     rules = all_program_rules()
     assert {"unguarded-shared-field", "guarded-by-violation",
-            "requires-lock-violation", "lock-order-cycle"} \
+            "requires-lock-violation", "lock-order-cycle",
+            "bf16-unsafe-reduction", "master-weight-violation",
+            "unscaled-grad-use", "redundant-cast", "quant-code-arith"} \
         <= set(rules)
     for name, rule in rules.items():
         assert rule.name == name and rule.summary
@@ -1252,6 +1254,411 @@ class TestLockOrderCycle:
         assert "Pair._b" in found[0].message
 
 
+# ----------------------------------------- precision (program) rules
+
+
+class TestBf16UnsafeReduction:
+    """P1: reductions must not accumulate in a low-precision dtype —
+    inferred-bf16 operands, Pallas-kernel accumulators that follow a
+    raw ``*_ref`` load, and traced mean-family reductions with no fp32
+    anchor anywhere on the operand's flow."""
+
+    RULE = "bf16-unsafe-reduction"
+
+    def test_flagged_mean_on_inferred_bf16(self):
+        found = lint("""
+            import jax.numpy as jnp
+
+            def attn_probs(scores):
+                s16 = scores.astype(jnp.bfloat16)
+                return jnp.mean(s16, axis=-1)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "low-precision" in found[0].message
+
+    def test_clean_fp32_anchor(self):
+        assert lint("""
+            import jax.numpy as jnp
+
+            def attn_probs(scores):
+                return jnp.mean(scores.astype(jnp.float32), axis=-1)
+        """, self.RULE) == []
+
+    def test_clean_fp32_dtype_kwarg(self):
+        # dtype=jnp.float32 IS the fp32 accumulator, whatever the
+        # operand's storage dtype
+        assert lint("""
+            import jax.numpy as jnp
+
+            def attn_probs(scores):
+                s16 = scores.astype(jnp.bfloat16)
+                return jnp.mean(s16, axis=-1, dtype=jnp.float32)
+        """, self.RULE) == []
+
+    def test_flagged_pallas_kernel_raw_ref_reduction(self):
+        # the kernel-accumulator fixture: a reduction on a raw ref
+        # load follows the input dtype — a bf16 pool accumulates bf16
+        found = lint("""
+            import jax.numpy as jnp
+
+            def _lse_kernel(x_ref, o_ref):
+                x = x_ref[:]
+                o_ref[:] = jnp.sum(x, axis=1)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "Pallas" in found[0].message
+
+    def test_clean_pallas_kernel_upcast_load(self):
+        assert lint("""
+            import jax.numpy as jnp
+
+            def _lse_kernel(x_ref, o_ref):
+                x = x_ref[:].astype(jnp.float32)
+                o_ref[:] = jnp.sum(x, axis=1)
+        """, self.RULE) == []
+
+    def test_flagged_pallas_dot_without_preferred_element_type(self):
+        # the MXU shape: without preferred_element_type=f32 the
+        # contraction accumulates in the input dtype
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def _qk_kernel(q_ref, k_ref, s_ref):
+                s_ref[:] = jax.lax.dot_general(
+                    k_ref[:], q_ref[:], (((1,), (1,)), ((), ())))
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "preferred_element_type" in found[0].message
+
+    def test_clean_pallas_dot_with_preferred_element_type(self):
+        assert lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def _qk_kernel(q_ref, k_ref, s_ref):
+                s_ref[:] = jax.lax.dot_general(
+                    k_ref[:], q_ref[:], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+        """, self.RULE) == []
+
+    def test_flagged_unanchored_traced_mean(self):
+        # the resnet-head shape this rule caught for real: a traced
+        # mean on a value that follows the compute dtype
+        found = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def head(x):
+                return jnp.mean(x, axis=(1, 2))
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "no fp32 anchor" in found[0].message
+
+    def test_reduce_fp32_mark_excuses_the_site(self):
+        assert lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def head(x):
+                return jnp.mean(x, axis=(1, 2))  # graftlint: reduce-fp32
+        """, self.RULE) == []
+
+    def test_clean_interprocedural_fp32_summary(self):
+        # the helper's return dtype is known program-wide, so the
+        # caller's reduction is anchored through the summary
+        assert lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def to_probs(logits):
+                return jax.nn.softmax(logits.astype(jnp.float32))
+
+            @jax.jit
+            def entropy(logits):
+                p = to_probs(logits)
+                return jnp.mean(p * jnp.log(p))
+        """, self.RULE) == []
+
+
+class TestMasterWeightViolation:
+    """P2: the O2 contract — optimizer updates land on fp32 masters."""
+
+    RULE = "master-weight-violation"
+
+    MARKED = """
+        import jax.numpy as jnp
+
+        # graftlint: precision(master-fp32)
+        def adam_update(grads, params):
+            return params
+
+        def step(state, grads):
+            {prep}
+            return adam_update(grads, {arg})
+    """
+
+    def test_flagged_marked_fn_called_with_bf16(self):
+        found = lint(self.MARKED.format(
+            prep="half = state.params.astype(jnp.bfloat16)",
+            arg="half"), self.RULE)
+        assert names(found) == [self.RULE]
+        assert "master-fp32" in found[0].message
+
+    def test_clean_marked_fn_called_with_fp32(self):
+        assert lint(self.MARKED.format(
+            prep="masters = state.params.astype(jnp.float32)",
+            arg="masters"), self.RULE) == []
+
+    def test_flagged_apply_updates_on_half_params(self):
+        found = lint("""
+            import jax.numpy as jnp
+            import optax
+
+            def step(params, updates):
+                half = params.astype(jnp.float16)
+                return optax.apply_updates(half, updates)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "fp32 master" in found[0].message
+
+    def test_clean_apply_updates_on_unknown_params(self):
+        # params of unknown dtype are not flagged — the rule fires on
+        # *proven* low precision, suppressions stay rare
+        assert lint("""
+            import optax
+
+            def step(params, updates):
+                return optax.apply_updates(params, updates)
+        """, self.RULE) == []
+
+    def test_flagged_param_downcast_inside_marked_body(self):
+        found = lint("""
+            import jax.numpy as jnp
+
+            # graftlint: precision(master-fp32)
+            def adam_update(grads, params):
+                p = params.astype(jnp.bfloat16)
+                return p + grads
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "masters stay fp32" in found[0].message
+
+
+class TestUnscaledGradUse:
+    """P3: grads carry the loss scale until unscale/apply_gradients —
+    norms and clips computed before that silently track the scale."""
+
+    RULE = "unscaled-grad-use"
+
+    def test_flagged_clip_on_scaled_grads(self):
+        found = lint("""
+            import jax
+            from apex_tpu.optim import clip_grad_norm
+
+            def train_step(state, batch):
+                def loss_fn(p):
+                    return state.scale_loss((p * batch).sum())
+                grads = jax.grad(loss_fn)(state.params)
+                grads, norm = clip_grad_norm(grads, 1.0)
+                new_state, ok = state.apply_gradients(grads=grads)
+                return new_state
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "still carry the loss scale" in found[0].message
+
+    def test_flagged_norm_of_value_and_grad_result(self):
+        found = lint("""
+            import jax
+            from apex_tpu.utils.tree import tree_l2_norm
+
+            def train_step(state, batch):
+                def loss_fn(p):
+                    return state.scale_loss((p * batch).sum())
+                loss, grads = jax.value_and_grad(loss_fn)(state.params)
+                gnorm = tree_l2_norm(grads)
+                new_state, ok = state.apply_gradients(grads=grads)
+                return new_state, gnorm
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_clean_unscale_before_clip(self):
+        assert lint("""
+            import jax
+            from apex_tpu.optim import clip_grad_norm
+
+            def train_step(state, batch):
+                def loss_fn(p):
+                    return state.scale_loss((p * batch).sum())
+                grads = jax.grad(loss_fn)(state.params)
+                grads = state.loss_scaler.unscale(
+                    state.loss_scale_state, grads)
+                grads, norm = clip_grad_norm(grads, 1.0)
+                new_state, ok = state.apply_gradients(grads=grads)
+                return new_state
+        """, self.RULE) == []
+
+    def test_clean_apply_gradients_unscales_internally(self):
+        assert lint("""
+            import jax
+
+            def train_step(state, batch):
+                def loss_fn(p):
+                    return state.scale_loss((p * batch).sum())
+                grads = jax.grad(loss_fn)(state.params)
+                new_state, ok = state.apply_gradients(grads=grads)
+                return new_state
+        """, self.RULE) == []
+
+    def test_clean_without_loss_scaling_in_scope(self):
+        # no scale multiply anywhere: grads are unscaled, clip freely
+        assert lint("""
+            import jax
+            from apex_tpu.optim import clip_grad_norm
+
+            def train_step(params, batch):
+                def loss_fn(p):
+                    return (p * batch).sum()
+                grads = jax.grad(loss_fn)(params)
+                grads, norm = clip_grad_norm(grads, 1.0)
+                return grads
+        """, self.RULE) == []
+
+
+class TestRedundantCast:
+    """P4: chained astype — dead intermediate, precision round-trip."""
+
+    RULE = "redundant-cast"
+
+    def test_flagged_round_trip_chain(self):
+        found = lint("""
+            import jax.numpy as jnp
+
+            def f(x):
+                return x.astype(jnp.float32).astype(jnp.bfloat16)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "dead" in found[0].message
+
+    def test_flagged_same_dtype_twice(self):
+        found = lint("""
+            import jax.numpy as jnp
+
+            def f(x):
+                return x.astype(jnp.float32).astype(jnp.float32)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "already produced" in found[0].message
+
+    def test_clean_single_casts(self):
+        assert lint("""
+            import jax.numpy as jnp
+
+            def f(x, out_dtype):
+                y = x.astype(jnp.float32)
+                return (y * 2).astype(out_dtype)
+        """, self.RULE) == []
+
+    def test_lowprec_mark_excuses_deliberate_round_trip(self):
+        # quantize-dequantize simulation is a legitimate chain when
+        # the justification is recorded
+        assert lint("""
+            import jax.numpy as jnp
+
+            def quantize_sim(x):
+                # graftlint: lowprec(round-trip simulates the bf16 storage path on purpose)
+                return x.astype(jnp.bfloat16).astype(jnp.float32)
+        """, self.RULE) == []
+
+
+class TestQuantCodeArith:
+    """P5: int8/fp8 values are *codes*; arithmetic outside a blessed,
+    justified dequant site is flagged."""
+
+    RULE = "quant-code-arith"
+
+    def test_flagged_sum_over_codes(self):
+        found = lint("""
+            import jax.numpy as jnp
+
+            def accumulate(codes):
+                q = codes.astype(jnp.int8)
+                return jnp.sum(q, axis=0)
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "quantization codes" in found[0].message
+
+    def test_flagged_binop_on_codes(self):
+        # the classic mistake: scaling the codes without widening
+        # first — int8 * float silently promotes element-wise but the
+        # intent was a dequant
+        found = lint("""
+            import jax.numpy as jnp
+
+            def dequant_wrong(codes, scale):
+                q = codes.astype(jnp.int8)
+                return q * scale
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+
+    def test_clean_widening_accumulate(self):
+        # the ddp.py int8-allreduce shape: widen to int32, then sum
+        assert lint("""
+            import jax.numpy as jnp
+
+            def accumulate(codes):
+                q = codes.astype(jnp.int8)
+                return jnp.sum(q.astype(jnp.int32), axis=0)
+        """, self.RULE) == []
+
+    def test_clean_structural_ops_on_codes(self):
+        assert lint("""
+            import jax.numpy as jnp
+
+            def pack(codes, n, m):
+                q = codes.astype(jnp.int8)
+                flat = jnp.pad(q.ravel(), (0, 3))
+                return flat.reshape(n, m)
+        """, self.RULE) == []
+
+    def test_lowprec_mark_excuses_with_justification(self):
+        # the suppressed twin of the flagged fixture
+        assert lint("""
+            import jax.numpy as jnp
+
+            def saturating_sum(codes):
+                q = codes.astype(jnp.int8)
+                return jnp.sum(q, axis=0)  # graftlint: lowprec(int8 saturation is the desired clamp here)
+        """, self.RULE) == []
+
+    def test_nested_scope_walrus_does_not_pollute_outer_env(self):
+        # regression: the NamedExpr harvest walked into nested defs,
+        # so an inner `q := ...astype(int8)` marked the OUTER `q` as
+        # quant and a clean fp32 sum was falsely flagged
+        assert lint("""
+            import jax.numpy as jnp
+
+            def outer(codes, xs):
+                def inner():
+                    return (q := codes.astype(jnp.int8))
+                q = xs.astype(jnp.float32)
+                return jnp.sum(q, axis=0), inner
+        """, self.RULE) == []
+
+    def test_empty_lowprec_justification_is_itself_flagged(self):
+        found = lint("""
+            import jax.numpy as jnp
+
+            def accumulate(codes):
+                q = codes.astype(jnp.int8)
+                return jnp.sum(q, axis=0)  # graftlint: lowprec()
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "no justification" in found[0].message
+
+
 # -------------------------------------------------------- CLI / tree
 
 class TestCli:
@@ -1372,3 +1779,8 @@ def test_repo_tree_is_clean_within_budget():
     # of the four rules happened to trigger the memoization first)
     assert "unguarded-shared-field" in run_stats["rules_s"]
     assert run_stats["rules_s"].get("concurrency-pass", 0.0) > 0.0
+    # same contract for the precision pass: the dtype-flow analysis
+    # ran, charged to its own `precision-pass` row, and its five rules
+    # are registered against the tree
+    assert "bf16-unsafe-reduction" in run_stats["rules_s"]
+    assert run_stats["rules_s"].get("precision-pass", 0.0) > 0.0

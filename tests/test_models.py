@@ -273,6 +273,37 @@ class TestResNet:
         assert all(np.all(np.isfinite(np.asarray(g)))
                    for g in jax.tree.leaves(grads))
 
+    def test_global_pool_accumulates_fp32_under_half_dtype(self, rng):
+        """ISSUE-10 regression (found by graftlint's
+        bf16-unsafe-reduction): the head's global average pool used to
+        run in the compute dtype, so a bf16/O3 model accumulated its
+        spatial mean in bf16.  The pool is now anchored fp32 — spy on
+        the (1, 2)-axis mean and assert its operand dtype whatever the
+        model's compute dtype says."""
+        from apex_tpu.models import ResNet, ResNetConfig
+        cfg = ResNetConfig(stage_sizes=(1,), num_classes=2, width=8,
+                           dtype=jnp.bfloat16)
+        m = ResNet(cfg)
+        x = jnp.asarray(rng.normal(size=(1, 8, 8, 3)), jnp.bfloat16)
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+
+        seen = []
+        real_mean = jnp.mean
+
+        def spy(a, *args, **kw):
+            if kw.get("axis") == (1, 2):
+                seen.append(jnp.asarray(a).dtype)
+            return real_mean(a, *args, **kw)
+
+        try:
+            jnp.mean = spy
+            logits = m.apply(v, x, train=False)
+        finally:
+            jnp.mean = real_mean
+        assert seen, "the global-pool mean was never reached"
+        assert all(d == jnp.float32 for d in seen), seen
+        assert logits.dtype == jnp.float32          # fp32 classifier
+
     # [slow: ~13s of resnet compile; BN running-stat update/eval
     # semantics stay tier-1-pinned at the op layer in
     # test_batch_norm.py — runs under -m slow + on-chip]

@@ -1,0 +1,258 @@
+"""Unit tier for the runtime numerics sanitizer
+(``apex_tpu.utils.numcheck``) — the dynamic twin of graftlint's
+precision pass, the way ``tests/test_lockcheck.py`` pins the lock
+sanitizer: instrument idempotence, strict mode in both directions
+(a planted master-weight breach is recorded strict-only), the
+``APEX_TPU_NUMCHECK`` env gate, underflow detection on a synthetic
+tiny-grad step, dtype histograms at the amp cast boundaries, and the
+loss-scale growth/backoff counters numcheck reads.
+
+Every test instruments inside a try/finally ``uninstrument()`` so the
+process-wide hooks never leak into the rest of the suite.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.core.loss_scale import DynamicLossScale
+from apex_tpu.core.precision import PrecisionPolicy, tree_cast
+from apex_tpu.utils import numcheck
+from apex_tpu.utils.metrics import counters
+
+
+def _make_state(opt_level="O2", half_dtype=jnp.float16, **overrides):
+    def apply_fn(p, x):
+        return x @ p["w"]
+
+    params = {"w": jnp.ones((4, 4), jnp.float32),
+              "b": jnp.zeros((4,), jnp.float32)}
+    return amp.initialize(apply_fn, params, optax.sgd(0.1),
+                          opt_level=opt_level, half_dtype=half_dtype,
+                          **overrides)
+
+
+def _grads(zero_rows=0, value=1e-3):
+    g = jnp.full((4, 4), value, jnp.float32)
+    if zero_rows:
+        g = g.at[:zero_rows].set(0.0)
+    return {"w": g, "b": jnp.full((4,), value, jnp.float32)}
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    numcheck.reset()
+    yield
+    numcheck.uninstrument()
+    numcheck.reset()
+
+
+class TestInstrument:
+    def test_idempotent_single_wrapper_and_single_count(self):
+        numcheck.instrument(strict=True)
+        numcheck.instrument(strict=True)        # second call: no-op
+        state = _make_state()
+        state.apply_gradients(grads=_grads())
+        jax.effects_barrier()
+        s = numcheck.summary()
+        # one step -> exactly one grad-stat emission (a double wrap
+        # would double-count)
+        assert s["grad_stat_steps"] == 1
+        from apex_tpu.core.train_state import MixedPrecisionTrainState
+        fn = MixedPrecisionTrainState.apply_gradients
+        assert getattr(fn, "_numcheck_wrapper", False)
+
+    def test_uninstrument_restores_originals(self):
+        from apex_tpu.core.train_state import MixedPrecisionTrainState
+        orig = MixedPrecisionTrainState.apply_gradients
+        numcheck.instrument(strict=True)
+        assert MixedPrecisionTrainState.apply_gradients is not orig
+        numcheck.uninstrument()
+        assert MixedPrecisionTrainState.apply_gradients is orig
+        # and a fresh instrument works again after uninstrument
+        numcheck.instrument(strict=True)
+        assert MixedPrecisionTrainState.apply_gradients is not orig
+
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_NUMCHECK", "strict")
+        assert numcheck.env_strict()
+        numcheck.instrument()                   # strict=None follows env
+        bad = _make_state().replace(
+            params=tree_cast(_make_state().params, jnp.bfloat16))
+        bad.apply_gradients(grads=tree_cast(_grads(), jnp.bfloat16))
+        jax.effects_barrier()
+        assert numcheck.reports()               # env made it strict
+        numcheck.uninstrument()
+        monkeypatch.delenv("APEX_TPU_NUMCHECK")
+        assert not numcheck.env_strict()
+
+
+class TestStrictBothDirections:
+    def test_master_weight_breach_recorded_strict(self):
+        numcheck.instrument(strict=True)
+        state = _make_state()                   # O2: fp32 masters
+        bad = state.replace(params=tree_cast(state.params, jnp.bfloat16))
+        bad.apply_gradients(grads=tree_cast(_grads(), jnp.bfloat16))
+        jax.effects_barrier()
+        found = numcheck.reports()
+        assert len(found) == 1
+        assert "non-fp32 master weights" in found[0]
+        assert "master-weight-violation" in found[0]   # the static twin
+        with pytest.raises(numcheck.NumCheckError):
+            numcheck.assert_clean()
+        # deduped: the same breach again is still one report
+        bad.apply_gradients(grads=tree_cast(_grads(), jnp.bfloat16))
+        jax.effects_barrier()
+        assert len(numcheck.reports()) == 1
+
+    def test_same_breach_not_recorded_non_strict(self):
+        numcheck.instrument(strict=False)
+        state = _make_state()
+        bad = state.replace(params=tree_cast(state.params, jnp.bfloat16))
+        bad.apply_gradients(grads=tree_cast(_grads(), jnp.bfloat16))
+        jax.effects_barrier()
+        assert numcheck.reports() == []
+        numcheck.assert_clean()                 # observe-only: clean
+        # ...but observation still happened
+        assert numcheck.summary()["grad_stat_steps"] == 1
+
+    def test_clean_run_is_clean_strict(self):
+        numcheck.instrument(strict=True)
+        state = _make_state()
+        state, finite = state.apply_gradients(grads=_grads())
+        jax.effects_barrier()
+        assert bool(finite)
+        numcheck.assert_clean()
+
+
+class TestGradStats:
+    def test_underflow_fraction_on_synthetic_tiny_grad_step(self):
+        numcheck.instrument(strict=True)
+        state = _make_state()
+        # 2 of 4 rows of w flushed to exactly zero (the fp16 underflow
+        # signature after loss-scale multiply): 8/16 w-elems + 0/4
+        # b-elems -> 8/20 overall
+        state.apply_gradients(grads=_grads(zero_rows=2))
+        jax.effects_barrier()
+        s = numcheck.summary()
+        assert s["grad_total_elems"] == 20
+        assert s["grad_zero_elems"] == 8
+        assert s["grad_underflow_frac"] == pytest.approx(0.4)
+        # mirrored onto the shared counters for bench emissions
+        assert counters.get("numcheck.grad_total") >= 20
+
+    def test_nonfinite_grads_counted_not_flagged(self):
+        # a non-finite scaled grad is the dynamic scaler's expected
+        # diet: the step skips, numcheck counts, nothing is flagged
+        numcheck.instrument(strict=True)
+        state = _make_state()
+        g = _grads()
+        g["w"] = g["w"].at[0, 0].set(jnp.inf)
+        new_state, finite = state.apply_gradients(grads=g)
+        jax.effects_barrier()
+        assert not bool(finite)
+        np.testing.assert_array_equal(      # step skipped: params kept
+            new_state.params["w"], state.params["w"])
+        s = numcheck.summary()
+        assert s["nonfinite_grad_steps"] == 1
+        assert s["nonfinite_grad_elems"] >= 1
+        numcheck.assert_clean()
+
+    def test_stats_recorded_under_jit(self):
+        numcheck.instrument(strict=True)
+        state = _make_state()
+
+        @jax.jit
+        def step(st, g):
+            return st.apply_gradients(grads=g)
+
+        for _ in range(3):
+            state, _ = step(state, _grads(zero_rows=1))
+        jax.effects_barrier()
+        s = numcheck.summary()
+        assert s["grad_stat_steps"] == 3
+        assert s["grad_underflow_frac"] == pytest.approx(4 / 20)
+        numcheck.assert_clean()
+
+
+class TestCastBoundaries:
+    def test_dtype_histograms_at_cast_sites(self):
+        numcheck.instrument(strict=False)
+        policy = PrecisionPolicy.O2(half_dtype=jnp.bfloat16)
+        tree = {"w": jnp.ones((2, 2), jnp.float32)}
+        policy.cast_to_compute(tree)
+        hists = numcheck.site_histograms()
+        assert hists["cast_to_compute.in"] == {"float32": 1}
+        assert hists["cast_to_compute.out"] == {"bfloat16": 1}
+
+    def test_fp16_downcast_overflow_is_a_strict_violation(self):
+        numcheck.instrument(strict=True)
+        policy = PrecisionPolicy.O3(half_dtype=jnp.float16)
+        big = {"w": jnp.full((2, 2), 1e30, jnp.float32)}  # > fp16 max
+        policy.cast_to_param(big)
+        jax.effects_barrier()
+        found = numcheck.reports()
+        assert len(found) == 1 and "downcast overflow" in found[0]
+
+    def test_bf16_downcast_cannot_overflow(self):
+        # bf16 shares fp32's exponent range: same magnitudes, clean
+        numcheck.instrument(strict=True)
+        policy = PrecisionPolicy.O3(half_dtype=jnp.bfloat16)
+        big = {"w": jnp.full((2, 2), 1e30, jnp.float32)}
+        policy.cast_to_param(big)
+        jax.effects_barrier()
+        numcheck.assert_clean()
+
+
+class TestLossScaleEvents:
+    def test_growth_and_backoff_counted_and_read_by_summary(self):
+        before_g = counters.get("amp.loss_scale.growth")
+        before_b = counters.get("amp.loss_scale.backoff")
+        ls = DynamicLossScale(growth_interval=2)
+        st = ls.init()
+        st = ls.adjust(st, jnp.asarray(True))
+        st = ls.adjust(st, jnp.asarray(True))       # clean x2 -> growth
+        st = ls.adjust(st, jnp.asarray(False))      # overflow -> backoff
+        jax.effects_barrier()
+        assert counters.get("amp.loss_scale.growth") == before_g + 1
+        assert counters.get("amp.loss_scale.backoff") == before_b + 1
+        s = numcheck.summary()
+        assert s["loss_scale_growth"] >= before_g + 1
+        assert s["loss_scale_backoff"] >= before_b + 1
+
+    def test_no_growth_event_when_pinned_at_max_scale(self):
+        # review regression: the growth event is derived from the
+        # actual scale change, not the trigger condition — a healthy
+        # run saturated at max_scale must not log a fake growth every
+        # interval forever
+        before = counters.get("amp.loss_scale.growth")
+        ls = DynamicLossScale(init_scale=2.0 ** 24,
+                              max_scale=2.0 ** 24, growth_interval=1)
+        st = ls.init()
+        st = ls.adjust(st, jnp.asarray(True))   # trigger fires, pinned
+        jax.effects_barrier()
+        assert float(st.loss_scale) == 2.0 ** 24
+        assert counters.get("amp.loss_scale.growth") == before
+
+    def test_count_events_false_is_silent(self):
+        before = counters.get("amp.loss_scale.backoff")
+        ls = DynamicLossScale(count_events=False)
+        st = ls.adjust(ls.init(), jnp.asarray(False))
+        jax.effects_barrier()
+        assert float(st.loss_scale) == 2.0 ** 15     # still backs off
+        assert counters.get("amp.loss_scale.backoff") == before
+
+    def test_reset_clears_stats_but_not_instrumentation(self):
+        numcheck.instrument(strict=True)
+        state = _make_state()
+        state.apply_gradients(grads=_grads())
+        jax.effects_barrier()
+        assert numcheck.summary()["grad_stat_steps"] == 1
+        numcheck.reset()
+        assert numcheck.summary()["grad_stat_steps"] == 0
+        state.apply_gradients(grads=_grads())   # still instrumented
+        jax.effects_barrier()
+        assert numcheck.summary()["grad_stat_steps"] == 1

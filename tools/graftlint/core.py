@@ -158,6 +158,7 @@ def register_program(rule_cls: type) -> type:
 def _load_rule_modules() -> None:
     # rules self-register on import; import lazily to avoid a cycle
     from tools.graftlint import concurrency as _conc  # noqa: F401
+    from tools.graftlint import precision as _prec  # noqa: F401
     from tools.graftlint import rules as _rules  # noqa: F401
 
 

@@ -1,0 +1,1037 @@
+"""graftlint precision pass — whole-program mixed-precision dtype-flow.
+
+Mixed precision is the library's headline capability (amp O1/O2/O3,
+dynamic loss scaling, fp32 master weights), and its failure mode is the
+worst kind: a bf16 softmax reduction, an optimizer update applied to
+non-master params, or grad clipping computed on *scaled* grads does not
+crash — it silently bends the loss curve.  Trace hygiene (``rules.py``)
+and thread hygiene (``concurrency.py``) are machine-checked; this pass
+closes the third gap with an interprocedural **dtype-flow analysis**:
+
+1. **A dtype lattice** — every expression is inferred to one of
+   ``fp32`` (float32/64), ``low`` (bfloat16/float16), ``quant``
+   (int8/uint8/fp8 *codes* — values that are meaningless without their
+   scale), ``storage`` (a Pallas ``*_ref`` load — follows the pool /
+   input dtype, so possibly low), ``safe`` (ints/bools — exact
+   accumulation), or ``unknown``.  Facts flow from ``astype(...)``
+   casts, ``dtype=`` / ``preferred_element_type=`` kwargs, array
+   constructors, dtype-typed defaults, and assignments.
+
+2. **Function summaries** — every program function's return lattice
+   (tuples element-wise) is computed once, program-wide, so
+   ``aux = top_k_gating(...)[2]`` in one file knows the helper in
+   another returns fp32.  ``jax.vmap(f)(...)`` / ``jit(f)(...)``
+   resolve through to ``f``'s summary.
+
+3. **Rules** (each with flagged+clean fixtures in
+   ``tests/test_graftlint.py``): ``bf16-unsafe-reduction``,
+   ``master-weight-violation``, ``unscaled-grad-use``,
+   ``redundant-cast``, ``quant-code-arith`` — see the class docstrings
+   and the catalog in ``docs/graftlint.md``.
+
+Annotation convention (mirroring the concurrency pass's guarded-by
+discipline; trailing, or on a standalone comment line directly above):
+
+- ``# graftlint: precision(master-fp32)`` on a ``def``: the function
+  consumes master weights — no call site may pass a value inferred
+  low/quant, and the body must not downcast a parameter.
+- ``# graftlint: reduce-fp32`` on a reduction line (or its ``def``):
+  asserts the accumulation is fp32 *by construction* in a way the
+  lattice cannot see (an upstream contract, a log2-domain online
+  softmax with an f32 accumulator held elsewhere).
+- ``# graftlint: lowprec(<why>)`` on a line (or ``def``): a justified
+  deliberate low-precision / code-arithmetic exception.  The reason is
+  mandatory — an empty ``lowprec()`` is itself flagged, exactly like
+  an empty ``unguarded()``.
+
+The runtime twin is :mod:`apex_tpu.utils.numcheck` (the lockcheck
+mold): it hooks the amp cast boundaries, the optimizer step and the
+loss-scale path and records per-site dtype histograms, non-finite
+counts and the grad underflow-to-zero fraction, so the static
+convention and the runtime verifier converge from both directions under
+the strict chaos soaks.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple, Union
+
+from tools.graftlint.core import (
+    Finding,
+    ModuleContext,
+    ProgramRule,
+    closure_taint,
+    expr_tainted,
+    last_attr,
+    register_program,
+)
+
+__all__ = ["analyze_precision"]
+
+# ---------------------------------------------------------------- lattice
+
+FP32 = "fp32"
+LOW = "low"
+QUANT = "quant"
+STORAGE = "storage"
+SAFE = "safe"
+UNKNOWN = "unknown"
+NEUTRAL = "neutral"          # python scalars / dtype objects: join identity
+
+Lat = str
+LatOrTuple = Union[str, Tuple]
+
+_FP32_NAMES = {"float32", "float64", "f32", "fp32", "double"}
+_LOW_NAMES = {"bfloat16", "float16", "bf16", "fp16", "half"}
+_QUANT_NAMES = {"int8", "uint8", "fp8", "float8_e4m3fn", "float8_e5m2",
+                "float8_e4m3", "float8_e4m3b11fnuz", "float8_e5m2fnuz"}
+_SAFE_NAMES = {"int16", "int32", "int64", "uint16", "uint32", "uint64",
+               "bool", "bool_", "uint8_t"}
+
+
+def _join(a: Lat, b: Lat) -> Lat:
+    """Numpy-promotion-shaped join.  fp32 dominates (any float op with
+    an fp32 operand promotes); ``safe`` ints are transparent;
+    ``unknown`` is absorbing among the rest."""
+    if a == NEUTRAL:
+        return b
+    if b == NEUTRAL:
+        return a
+    if a == b:
+        return a
+    if FP32 in (a, b):
+        return FP32
+    if UNKNOWN in (a, b):
+        return UNKNOWN
+    if SAFE in (a, b):                      # int op float -> the float
+        return a if b == SAFE else b
+    if {a, b} == {LOW, STORAGE}:
+        return LOW                          # storage is at worst low
+    return UNKNOWN                          # quant mixed with floats
+
+
+def _collapse(lat: LatOrTuple) -> Lat:
+    if isinstance(lat, tuple):
+        out: Lat = NEUTRAL
+        for el in lat:
+            out = _join(out, _collapse(el))
+        return out
+    return lat
+
+
+def _join_summaries(a: LatOrTuple, b: LatOrTuple) -> LatOrTuple:
+    if isinstance(a, tuple) and isinstance(b, tuple) and len(a) == len(b):
+        return tuple(_join_summaries(x, y) for x, y in zip(a, b))
+    return _join(_collapse(a), _collapse(b))
+
+
+# ------------------------------------------------------------------ marks
+
+_MARK_RE = re.compile(
+    r"graftlint:\s*(?:(precision)\(([^)]*)\)|(lowprec)\(([^)]*)\)"
+    r"|(reduce-fp32))")
+
+
+def _marks_for_line(ctx: ModuleContext, line: int) -> List[Tuple[str, str]]:
+    """Precision marks on ``line`` — trailing, or on a *standalone*
+    comment directly above (same attachment rule as the concurrency
+    pass: a trailing comment on the previous code line never leaks)."""
+    sup = ctx.suppressions
+    text = sup.graftlint_comments.get(line, "")
+    if line - 1 in sup.standalone_comment_lines:
+        text += " " + sup.graftlint_comments.get(line - 1, "")
+    out: List[Tuple[str, str]] = []
+    for m in _MARK_RE.finditer(text):
+        if m.group(1):
+            out.append(("precision", m.group(2).strip()))
+        elif m.group(3):
+            out.append(("lowprec", m.group(4).strip()))
+        elif m.group(5):
+            out.append(("reduce-fp32", ""))
+    return out
+
+
+# ------------------------------------------------------- dtype resolution
+
+def _dtype_name_lat(name: str) -> Optional[Lat]:
+    low = name.lower()
+    if low in _FP32_NAMES:
+        return FP32
+    if low in _LOW_NAMES:
+        return LOW
+    if low in _QUANT_NAMES:
+        return QUANT
+    if low in _SAFE_NAMES:
+        return SAFE
+    return None
+
+
+def _dtype_from_expr(node: Optional[ast.AST],
+                     dtype_env: Dict[str, Lat]) -> Optional[Lat]:
+    """Lattice a dtype-denoting expression resolves to (``jnp.bfloat16``,
+    ``"float32"``, a local bound to one), or None when unresolvable
+    (``x.dtype``, an opaque variable)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _dtype_name_lat(node.value)
+    if isinstance(node, ast.Name):
+        hit = dtype_env.get(node.id)
+        if hit is not None:
+            return hit
+        return _dtype_name_lat(node.id)
+    if isinstance(node, ast.Attribute):
+        if node.attr == "dtype":            # x.dtype: follows a value
+            return None
+        return _dtype_name_lat(node.attr)
+    if isinstance(node, ast.Call):          # jnp.dtype(jnp.int8)
+        la = _callee_name(node.func)
+        if la == "dtype" and node.args:
+            return _dtype_from_expr(node.args[0], dtype_env)
+    return None
+
+
+# ----------------------------------------------------------- op tables
+
+#: reductions whose *accumulation* loses precision in a low dtype —
+#: the rule-1 surface (max/min/argmax are exempt: no accumulation)
+_MEAN_FAMILY = {"softmax", "log_softmax", "logsumexp", "logaddexp",
+                "mean", "nanmean", "average", "var", "std", "nanvar",
+                "nanstd"}
+_SUM_FAMILY = {"sum", "nansum", "cumsum", "trace", "norm", "prod"}
+_REDUCTIONS = _MEAN_FAMILY | _SUM_FAMILY
+
+#: contractions: accumulator dtype set by preferred_element_type
+_DOT_FAMILY = {"dot", "dot_general", "matmul", "einsum", "tensordot"}
+
+#: dtype-preserving elementwise / structural ops the inference flows
+#: through (collectives included: wire dtype == operand dtype)
+_TRANSPARENT = {
+    "where", "clip", "round", "abs", "absolute", "negative", "exp",
+    "exp2", "expm1", "log", "log2", "log1p", "sqrt", "rsqrt", "square",
+    "maximum", "minimum", "add", "subtract", "multiply", "divide",
+    "true_divide", "power", "tanh", "sigmoid", "erf", "relu", "gelu",
+    "silu", "swish", "softplus", "sort", "flip", "reshape", "ravel",
+    "flatten", "pad", "transpose", "moveaxis", "swapaxes",
+    "broadcast_to", "concatenate", "stack", "hstack", "vstack",
+    "expand_dims", "squeeze", "take", "take_along_axis", "roll",
+    "tile", "repeat", "split", "cumprod", "copy", "conj", "real",
+    "stop_gradient", "dynamic_slice", "dynamic_update_slice", "select",
+    "all_to_all", "all_gather", "psum", "pmean", "pmax", "pmin",
+    "ppermute", "psum_scatter", "nan_to_num", "atleast_2d", "tril",
+    "triu", "set", "at", "astype_like",
+}
+
+#: constructors whose default dtype is float32 under jax
+_FP32_CTORS = {"zeros", "ones", "full", "empty", "eye", "linspace",
+               "uniform", "normal", "randn"}
+_LIKE_CTORS = {"zeros_like", "ones_like", "full_like", "empty_like"}
+
+#: boolean / index producers
+_SAFE_PRODUCERS = {"argmax", "argmin", "argsort", "isfinite", "isnan",
+                   "isinf", "any", "all", "sign", "searchsorted",
+                   "one_hot_int", "iota", "broadcasted_iota",
+                   "program_id", "num_programs", "axis_index",
+                   "categorical", "randint", "bernoulli"}
+
+#: functions that consume *unscaled* grads: calling them on grads that
+#: still carry the loss scale computes a scaled norm / clip threshold
+_NORM_CONSUMERS = {"clip_grad_norm", "clip_by_global_norm",
+                   "global_norm", "global_grad_clip_coef",
+                   "tree_l2_norm", "per_tensor_l2_norms"}
+
+#: jit-family wrappers resolved through to their operand's summary
+_WRAPPERS = {"vmap", "pmap", "jit", "pjit", "shard_map", "remat",
+             "checkpoint", "partial", "named_call"}
+
+_REF_RE = re.compile(r"_refs?$|^refs$")
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    """The method/function name a call dispatches to: ``astype`` for
+    ``f(x).astype``, ``mean`` for ``jnp.mean`` — unlike
+    :func:`last_attr` this survives calls inside the attribute chain
+    (``state.apply_fn(p, x).astype(...)``)."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _walk_no_nested(node: ast.AST):
+    """``ast.walk`` that does not descend into nested
+    defs/lambdas — their assignments belong to their own scope, not
+    the enclosing function's dtype environment."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, _FuncNode):
+                continue
+            stack.append(child)
+
+
+def _is_kernel(fn: ast.AST) -> bool:
+    """Pallas kernel heuristic: any parameter (incl. ``*refs``) named
+    ``*_ref``/``refs`` — the ``pl.pallas_call`` body convention."""
+    args = getattr(fn, "args", None)
+    if args is None:
+        return False
+    names = [a.arg for a in list(args.posonlyargs) + list(args.args)
+             + list(args.kwonlyargs)]
+    if args.vararg is not None:
+        names.append(args.vararg.arg)
+    return any(_REF_RE.search(n) for n in names)
+
+
+# ------------------------------------------------------------- inference
+
+class _FnScope:
+    """Dtype-flow facts for one function body."""
+
+    def __init__(self, ctx: ModuleContext, fn: ast.AST,
+                 summaries: Dict[str, LatOrTuple]):
+        self.ctx = ctx
+        self.fn = fn
+        self.summaries = summaries
+        self.env: Dict[str, Lat] = {}
+        self.dtype_env: Dict[str, Lat] = {}
+        self.kernel = _is_kernel(fn)
+        self._seed_params()
+        # two passes approximate a fixpoint (use-before-def in loops),
+        # same recipe as the taint engine
+        self._visit_body()
+        self._visit_body()
+
+    # ------------------------------------------------------------ seeds
+    def _seed_params(self) -> None:
+        args = self.fn.args
+        ordered = list(args.posonlyargs) + list(args.args)
+        defaults = list(args.defaults)
+        pad: List[Optional[ast.AST]] = [None] * (len(ordered)
+                                                 - len(defaults))
+        for arg, default in zip(ordered, pad + defaults):
+            d = _dtype_from_expr(default, {})
+            if d is not None and not isinstance(default, ast.Constant):
+                # dtype-object default (dtype=jnp.float32): the param
+                # *denotes* a dtype, it is not an array of that dtype
+                self.dtype_env[arg.arg] = d
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            d = _dtype_from_expr(default, {})
+            if d is not None and not isinstance(default, ast.Constant):
+                self.dtype_env[arg.arg] = d
+
+    # ------------------------------------------------------------- body
+    def _visit_body(self) -> None:
+        body = self.fn.body if isinstance(self.fn.body, list) \
+            else [self.fn.body]
+        self._visit_stmts(body)
+
+    def _visit_stmts(self, stmts) -> None:
+        for stmt in stmts:
+            self._visit_stmt(stmt)
+
+    def _assign(self, target: ast.AST, lat: LatOrTuple) -> None:
+        if isinstance(target, ast.Name):
+            d = None
+            # `dt = jnp.float32` binds a dtype object, not an array
+            if lat == NEUTRAL:
+                d = None
+            self.env[target.id] = _collapse(lat)
+            del d
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            if isinstance(lat, tuple) and len(lat) == len(elts):
+                for el, la in zip(elts, lat):
+                    self._assign(el, la)
+            else:
+                for el in elts:
+                    self._assign(el, _collapse(lat))
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, _collapse(lat))
+
+    def _visit_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, _FuncNode):
+            return                      # nested defs get their own scope
+        if isinstance(stmt, ast.Assign):
+            value = stmt.value
+            d = _dtype_from_expr(value, self.dtype_env) \
+                if not isinstance(value, ast.Constant) else None
+            lat = self.lat_of(value)
+            for t in stmt.targets:
+                if d is not None and isinstance(t, ast.Name) \
+                        and lat == NEUTRAL:
+                    self.dtype_env[t.id] = d     # dt = jnp.float32
+                else:
+                    self._assign(t, lat)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign(stmt.target, self.lat_of(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            lat = _join(self.lat_of(stmt.target), self.lat_of(stmt.value))
+            self._assign(stmt.target, lat)
+        elif isinstance(stmt, ast.For):
+            self._assign(stmt.target, self.lat_of(stmt.iter))
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars,
+                                 self.lat_of(item.context_expr))
+        for node in _walk_no_nested(stmt):
+            if isinstance(node, ast.NamedExpr):
+                self._assign(node.target, self.lat_of(node.value))
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, list):
+                self._visit_stmts(sub)
+        for handler in getattr(stmt, "handlers", ()):
+            self._visit_stmts(handler.body)
+
+    # ------------------------------------------------------------ lat_of
+    def lat_of(self, node: Optional[ast.AST]) -> LatOrTuple:
+        if node is None:
+            return NEUTRAL
+        if isinstance(node, ast.Constant):
+            return NEUTRAL
+        if isinstance(node, ast.Name):
+            if node.id in self.dtype_env:
+                return NEUTRAL              # a dtype object as a value
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Attribute):
+            if _dtype_name_lat(node.attr) is not None:
+                return NEUTRAL              # jnp.bfloat16 the *object*
+            if node.attr in ("shape", "ndim", "dtype", "size", "T"):
+                return NEUTRAL
+            return UNKNOWN
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            if isinstance(base, ast.Name) and _REF_RE.search(base.id):
+                return STORAGE              # Pallas ref load
+            return _collapse(self.lat_of(base))
+        if isinstance(node, ast.Call):
+            return self._lat_call(node)
+        if isinstance(node, ast.BinOp):
+            return _join(_collapse(self.lat_of(node.left)),
+                         _collapse(self.lat_of(node.right)))
+        if isinstance(node, ast.UnaryOp):
+            return _collapse(self.lat_of(node.operand))
+        if isinstance(node, (ast.Compare, ast.BoolOp)):
+            return SAFE
+        if isinstance(node, ast.IfExp):
+            return _join(_collapse(self.lat_of(node.body)),
+                         _collapse(self.lat_of(node.orelse)))
+        if isinstance(node, ast.Tuple):
+            return tuple(self.lat_of(el) for el in node.elts)
+        if isinstance(node, ast.List):
+            out: Lat = NEUTRAL
+            for el in node.elts:
+                out = _join(out, _collapse(self.lat_of(el)))
+            return out
+        if isinstance(node, ast.Starred):
+            return _collapse(self.lat_of(node.value))
+        if isinstance(node, ast.NamedExpr):
+            return _collapse(self.lat_of(node.value))
+        if isinstance(node, ast.Lambda):
+            return NEUTRAL
+        return UNKNOWN
+
+    def _kwarg(self, call: ast.Call, *names: str) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg in names:
+                return kw.value
+        return None
+
+    def dtype_kwarg_lat(self, call: ast.Call) -> Optional[Lat]:
+        expr = self._kwarg(call, "dtype", "preferred_element_type")
+        if expr is None:
+            return None
+        return _dtype_from_expr(expr, self.dtype_env)
+
+    def _lat_call(self, call: ast.Call) -> LatOrTuple:
+        la = _callee_name(call.func)
+        explicit = self.dtype_kwarg_lat(call)
+        if explicit is not None:
+            return explicit
+        if la == "astype":
+            if call.args:
+                d = _dtype_from_expr(call.args[0], self.dtype_env)
+                if d is not None:
+                    return d
+            return UNKNOWN                  # cast to an opaque dtype
+        if la in ("asarray", "array"):
+            if len(call.args) >= 2:
+                d = _dtype_from_expr(call.args[1], self.dtype_env)
+                if d is not None:
+                    return d
+            return _collapse(self.lat_of(call.args[0])) \
+                if call.args else UNKNOWN
+        if la in _FP32_CTORS:
+            return FP32                     # jax default float dtype
+        if la in _LIKE_CTORS:
+            return _collapse(self.lat_of(call.args[0])) \
+                if call.args else UNKNOWN
+        if la in _SAFE_PRODUCERS:
+            return SAFE
+        if la in _TRANSPARENT or la in _REDUCTIONS:
+            out: Lat = NEUTRAL
+            for arg in call.args:
+                if isinstance(arg, ast.Constant):
+                    continue
+                out = _join(out, _collapse(self.lat_of(arg)))
+            return out if out != NEUTRAL else UNKNOWN
+        if la in _DOT_FAMILY:
+            out = NEUTRAL
+            for arg in call.args:
+                if isinstance(arg, ast.Constant):
+                    continue                # einsum's spec string
+                out = _join(out, _collapse(self.lat_of(arg)))
+            return out if out != NEUTRAL else UNKNOWN
+        # jax.vmap(f)(...) / jit(f)(...): resolve through to f
+        if isinstance(call.func, ast.Call):
+            inner = call.func
+            ila = _callee_name(inner.func)
+            if ila in _WRAPPERS and inner.args:
+                target = inner.args[0]
+                if isinstance(target, ast.Name):
+                    hit = self.summaries.get(target.id)
+                    if hit is not None:
+                        return hit
+                if isinstance(target, ast.Lambda):
+                    sub = _FnScope(self.ctx, target, self.summaries)
+                    return sub.lat_of(target.body)
+            return UNKNOWN
+        if la is not None:
+            hit = self.summaries.get(la)
+            if hit is not None:
+                return hit
+        return UNKNOWN
+
+
+def _fn_summary(ctx: ModuleContext, fn: ast.AST,
+                summaries: Dict[str, LatOrTuple]) -> LatOrTuple:
+    """Return lattice of ``fn`` (tuples element-wise), joined over
+    every ``return`` statement in its own body (nested defs excluded)."""
+    returns = [node for node in ast.walk(fn)
+               if isinstance(node, ast.Return) and node.value is not None
+               and ctx.enclosing_function(node) is fn]
+    if not returns:
+        return UNKNOWN          # procedure: skip the body inference
+    scope = _FnScope(ctx, fn, summaries)
+    out: Optional[LatOrTuple] = None
+    for node in returns:
+        lat = scope.lat_of(node.value)
+        out = lat if out is None else _join_summaries(out, lat)
+    return out if out is not None else UNKNOWN
+
+
+# ------------------------------------------------------------ the analysis
+
+class _Analysis:
+    """One whole-program precision analysis over a module set."""
+
+    def __init__(self, contexts: List[ModuleContext]):
+        self.contexts = list(contexts)
+        self.findings: List[Finding] = []
+        # program-wide function table (bare name; first def wins the
+        # name, later ones join into the summary)
+        self.fns: List[Tuple[ModuleContext, ast.AST]] = []
+        self.by_name: Dict[str, List[Tuple[ModuleContext, ast.AST]]] = {}
+        for ctx in self.contexts:
+            for fn in ctx.functions():
+                if isinstance(fn, ast.Lambda):
+                    continue
+                self.fns.append((ctx, fn))
+                self.by_name.setdefault(fn.name, []).append((ctx, fn))
+        self.summaries: Dict[str, LatOrTuple] = {}
+        # defs marked `# graftlint: precision(master-fp32)`
+        self.master_fns: Dict[str, Tuple[ModuleContext, ast.AST]] = {}
+        for ctx, fn in self.fns:
+            for mark, arg in _marks_for_line(ctx, fn.lineno):
+                if mark == "precision" and arg == "master-fp32":
+                    self.master_fns[fn.name] = (ctx, fn)
+
+    # ---------------------------------------------------------- running
+    def run(self) -> List[Finding]:
+        # two summary rounds: round 2 sees round 1's results, so a
+        # helper calling a helper still resolves
+        for _ in range(2):
+            nxt: Dict[str, LatOrTuple] = {}
+            for ctx, fn in self.fns:
+                lat = _fn_summary(ctx, fn, self.summaries)
+                prev = nxt.get(fn.name)
+                nxt[fn.name] = lat if prev is None \
+                    else _join_summaries(prev, lat)
+            self.summaries = nxt
+        for ctx in self.contexts:
+            self._check_module(ctx)
+        return self.findings
+
+    def _finding(self, rule: str, ctx: ModuleContext, node: ast.AST,
+                 message: str) -> None:
+        f = Finding(rule, ctx.path, getattr(node, "lineno", 1),
+                    getattr(node, "col_offset", 0) + 1, message)
+        if f not in self.findings:
+            self.findings.append(f)
+
+    # ------------------------------------------------------- mark logic
+    def _site_marks(self, ctx: ModuleContext, node: ast.AST,
+                    fn: Optional[ast.AST]) -> List[Tuple[str, str]]:
+        marks = list(_marks_for_line(ctx, getattr(node, "lineno", 0)))
+        if fn is not None and not isinstance(fn, ast.Lambda):
+            marks += _marks_for_line(ctx, fn.lineno)
+        return marks
+
+    def _excused(self, rule: str, ctx: ModuleContext, node: ast.AST,
+                 fn: Optional[ast.AST]) -> bool:
+        """True when a ``reduce-fp32`` / justified ``lowprec`` mark on
+        the site (or its def) covers the would-be finding; an *empty*
+        lowprec justification is itself flagged."""
+        for mark, arg in self._site_marks(ctx, node, fn):
+            if mark == "reduce-fp32" and rule == "bf16-unsafe-reduction":
+                return True
+            if mark == "lowprec":
+                if not arg.strip():
+                    self._finding(
+                        rule, ctx, node,
+                        "lowprec() with no justification — the reason "
+                        "is the point of the annotation; say why the "
+                        "low-precision exception is sound")
+                    return True
+                return True
+        return False
+
+    # ------------------------------------------------------ module walk
+    def _check_module(self, ctx: ModuleContext) -> None:
+        entries = ctx.traced_entries()
+        for fn in ctx.functions():
+            if isinstance(fn, ast.Lambda):
+                continue
+            scope = _FnScope(ctx, fn, self.summaries)
+            tainted: Set[str] = set()
+            weak_ok = fn in entries and not scope.kernel
+            if weak_ok:
+                tainted = closure_taint(ctx, fn)
+            self._check_fn(ctx, fn, scope, tainted, weak_ok)
+            self._check_unscaled_grads(ctx, fn, scope)
+
+    def _own_nodes(self, ctx: ModuleContext, fn: ast.AST):
+        """Walk ``fn``'s body excluding nested defs (those get their
+        own scope and their own iteration)."""
+        for node in ast.walk(fn):
+            if isinstance(node, _FuncNode) and node is not fn:
+                continue
+            inner = ctx.enclosing_function(node)
+            if inner is not fn:
+                continue
+            yield node
+
+    def _check_fn(self, ctx: ModuleContext, fn: ast.AST,
+                  scope: _FnScope, tainted: Set[str],
+                  weak_ok: bool) -> None:
+        for node in self._own_nodes(ctx, fn):
+            if isinstance(node, ast.Call):
+                self._check_reduction(ctx, fn, scope, node, tainted,
+                                      weak_ok)
+                self._check_redundant_cast(ctx, fn, scope, node)
+                self._check_quant_call(ctx, fn, scope, node)
+                self._check_master_call(ctx, fn, scope, node)
+            elif isinstance(node, ast.BinOp):
+                self._check_quant_binop(ctx, fn, scope, node)
+
+    # -------------------------------------------------- rule 1: reduce
+    def _check_reduction(self, ctx: ModuleContext, fn: ast.AST,
+                         scope: _FnScope, call: ast.Call,
+                         tainted: Set[str], weak_ok: bool) -> None:
+        la = _callee_name(call.func)
+        is_dot = la in _DOT_FAMILY
+        if la not in _REDUCTIONS and not is_dot:
+            return
+        explicit = scope.dtype_kwarg_lat(call)
+        if explicit == FP32:
+            return                          # fp32 accumulator declared
+        args = [a for a in call.args if not isinstance(a, ast.Constant)]
+        if not args:
+            return
+        lat = NEUTRAL
+        for a in args:
+            lat = _join(lat, _collapse(scope.lat_of(a)))
+        if lat == LOW and not is_dot:
+            if self._excused("bf16-unsafe-reduction", ctx, call, fn):
+                return
+            self._finding(
+                "bf16-unsafe-reduction", ctx, call,
+                f"`{la}` accumulates in a low-precision dtype — the "
+                f"operand is bf16/fp16, so the reduction's running sum "
+                f"is too; cast the operand `.astype(jnp.float32)` (or "
+                f"pass `dtype=jnp.float32`), or mark the line "
+                f"`# graftlint: reduce-fp32` if an fp32 accumulator "
+                f"exists by construction")
+            return
+        if scope.kernel and lat in (STORAGE, LOW):
+            # Pallas body: the accumulator follows the pool/input dtype
+            if is_dot and explicit is not None:
+                return                      # non-fp32 but *deliberate*
+            if self._excused("bf16-unsafe-reduction", ctx, call, fn):
+                return
+            what = ("contraction without `preferred_element_type="
+                    "jnp.float32`" if is_dot else "reduction")
+            self._finding(
+                "bf16-unsafe-reduction", ctx, call,
+                f"Pallas kernel {what} on a raw `*_ref` load: the "
+                f"accumulator dtype follows the input, so a bf16/int8 "
+                f"pool accumulates in bf16/int8 — upcast the load "
+                f"`.astype(jnp.float32)` first"
+                + ("" if is_dot else " (or pass `dtype=jnp.float32`)")
+                + ", or mark `# graftlint: reduce-fp32`")
+            return
+        if weak_ok and not is_dot and la in _MEAN_FAMILY \
+                and lat == UNKNOWN:
+            if not any(expr_tainted(a, tainted) for a in args):
+                return
+            if self._excused("bf16-unsafe-reduction", ctx, call, fn):
+                return
+            self._finding(
+                "bf16-unsafe-reduction", ctx, call,
+                f"`{la}` in traced code on a value with no fp32 anchor "
+                f"— under a half-precision policy this operand follows "
+                f"the compute dtype and the reduction accumulates in "
+                f"it; cast the operand `.astype(jnp.float32)`, or mark "
+                f"`# graftlint: reduce-fp32` if it is fp32 by an "
+                f"upstream contract")
+
+    # --------------------------------------------- rule 2: master fp32
+    def _check_master_call(self, ctx: ModuleContext, fn: ast.AST,
+                           scope: _FnScope, call: ast.Call) -> None:
+        la = _callee_name(call.func)
+        if la in self.master_fns and la != getattr(fn, "name", None):
+            for arg in call.args:
+                lat = _collapse(scope.lat_of(arg))
+                if lat in (LOW, QUANT):
+                    if self._excused("master-weight-violation", ctx,
+                                     call, fn):
+                        return
+                    self._finding(
+                        "master-weight-violation", ctx, call,
+                        f"`{la}` is marked `# graftlint: precision"
+                        f"(master-fp32)` but this call passes a "
+                        f"{lat}-precision value — under O2 the "
+                        f"optimizer must consume fp32 master weights; "
+                        f"update the masters and re-cast for the "
+                        f"forward pass instead")
+                    return
+        # builtin shape: optax.apply_updates(params, updates) — the
+        # canonical optimizer-apply; params must be the fp32 masters
+        if la == "apply_updates" and call.args:
+            lat = _collapse(scope.lat_of(call.args[0]))
+            if lat in (LOW, QUANT):
+                if self._excused("master-weight-violation", ctx, call,
+                                 fn):
+                    return
+                self._finding(
+                    "master-weight-violation", ctx, call,
+                    f"optimizer update applied to {lat}-precision "
+                    f"params — under O2 the update must land on the "
+                    f"fp32 master copy (half-precision weight updates "
+                    f"lose every increment smaller than ~2^-8 of the "
+                    f"weight); apply to the masters, then "
+                    f"`cast_to_compute` for the forward pass")
+        # body contract: a master-fp32 def must not downcast a param
+        if getattr(fn, "name", None) in self.master_fns \
+                and la == "astype" and call.args:
+            target = _dtype_from_expr(call.args[0], scope.dtype_env)
+            obj = call.func.value if isinstance(call.func, ast.Attribute) \
+                else None
+            if target in (LOW, QUANT) and isinstance(obj, ast.Name):
+                params = {a.arg for a in fn.args.args
+                          + fn.args.posonlyargs + fn.args.kwonlyargs}
+                if obj.id in params and not self._excused(
+                        "master-weight-violation", ctx, call, fn):
+                    self._finding(
+                        "master-weight-violation", ctx, call,
+                        f"`{obj.id}` is a parameter of a `precision"
+                        f"(master-fp32)` function but is downcast to "
+                        f"{target} here — masters stay fp32 through "
+                        f"the update; cast only the forward-pass copy")
+
+    # ------------------------------------------- rule 3: unscaled grads
+    def _check_unscaled_grads(self, ctx: ModuleContext, fn: ast.AST,
+                              scope: _FnScope) -> None:
+        if isinstance(fn, ast.Lambda):
+            return
+        # only meaningful where a loss-scale multiply is in scope
+        has_scaling = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                la = _callee_name(node.func)
+                if la == "scale_loss" or (
+                        la == "scale" and isinstance(node.func,
+                                                     ast.Attribute)):
+                    has_scaling = True
+                    break
+        if not has_scaling:
+            return
+        scaled: Set[str] = set()
+
+        def names_in(expr: ast.AST) -> Set[str]:
+            return {n.id for n in ast.walk(expr)
+                    if isinstance(n, ast.Name)}
+
+        def grad_targets(stmt: ast.Assign) -> List[str]:
+            value = stmt.value
+            if not (isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Call)):
+                return []
+            inner = value.func
+            ila = _callee_name(inner.func)
+            if ila not in ("grad", "value_and_grad"):
+                return []
+            has_aux = any(kw.arg == "has_aux" for kw in inner.keywords)
+            target = stmt.targets[0]
+            if isinstance(target, ast.Name):
+                return [target.id]
+            if isinstance(target, (ast.Tuple, ast.List)):
+                elts = [e.id for e in target.elts
+                        if isinstance(e, ast.Name)]
+                if len(elts) == 2:
+                    if ila == "value_and_grad":
+                        return [elts[1]]       # (value, grad)
+                    if has_aux:
+                        return [elts[0]]       # (grad, aux)
+                return elts
+            return []
+
+        def scan(stmts) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, _FuncNode):
+                    continue
+                # uses first: a consumer on this line sees the grads
+                # as they were BEFORE any same-statement rebind
+                for node in ast.walk(stmt):
+                    if isinstance(node, _FuncNode):
+                        continue
+                    if isinstance(node, ast.Call):
+                        la = _callee_name(node.func)
+                        if la in _NORM_CONSUMERS and any(
+                                names_in(a) & scaled
+                                for a in node.args):
+                            if not self._excused("unscaled-grad-use",
+                                                 ctx, node, fn):
+                                self._finding(
+                                    "unscaled-grad-use", ctx, node,
+                                    f"`{la}` consumes gradients that "
+                                    f"still carry the loss scale — "
+                                    f"the norm/clip threshold is "
+                                    f"computed on scaled values, so "
+                                    f"clipping strength silently "
+                                    f"tracks the scale; unscale first "
+                                    f"(`loss_scaler.unscale`) or clip "
+                                    f"after `apply_gradients`")
+                if isinstance(stmt, ast.Assign):
+                    targets = grad_targets(stmt)
+                    if targets:
+                        scaled.update(targets)
+                    else:
+                        value = stmt.value
+                        kills = isinstance(value, ast.Call) and \
+                            _callee_name(value.func) in ("unscale",
+                                                      "apply_gradients")
+                        tnames = [t.id for t in stmt.targets
+                                  if isinstance(t, ast.Name)]
+                        if kills:
+                            scaled.difference_update(tnames)
+                            # g = ls.unscale(st, g): g is now clean
+                        elif names_in(value) & scaled:
+                            scaled.update(tnames)
+                        else:
+                            scaled.difference_update(tnames)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if isinstance(sub, list):
+                        scan(sub)
+                for handler in getattr(stmt, "handlers", ()):
+                    scan(handler.body)
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        scan(body)
+
+    # -------------------------------------------- rule 4: cast chains
+    def _check_redundant_cast(self, ctx: ModuleContext, fn: ast.AST,
+                              scope: _FnScope, call: ast.Call) -> None:
+        if _callee_name(call.func) != "astype" or not call.args:
+            return
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return
+        inner = func.value
+        if not (isinstance(inner, ast.Call)
+                and _callee_name(inner.func) == "astype" and inner.args):
+            return
+        d_in = _dtype_from_expr(inner.args[0], scope.dtype_env)
+        d_out = _dtype_from_expr(call.args[0], scope.dtype_env)
+        if d_in is None or d_out is None:
+            return
+        if self._excused("redundant-cast", ctx, call, fn):
+            return
+        if d_in == d_out:
+            why = "the inner cast already produced this dtype"
+        else:
+            why = ("the intermediate value is dead — on a hot path "
+                   "this round-trips precision and materializes an "
+                   "extra buffer")
+        self._finding(
+            "redundant-cast", ctx, call,
+            f"chained `.astype(...).astype(...)`: {why}; cast once to "
+            f"the final dtype (use `# graftlint: lowprec(<why>)` for a "
+            f"deliberate quantize-dequantize round-trip)")
+
+    # --------------------------------------------- rule 5: quant codes
+    def _quant_flag(self, ctx: ModuleContext, fn: ast.AST,
+                    node: ast.AST, how: str) -> None:
+        if self._excused("quant-code-arith", ctx, node, fn):
+            return
+        self._finding(
+            "quant-code-arith", ctx, node,
+            f"arithmetic on int8/fp8 quantization codes ({how}) — "
+            f"codes are meaningless without their scale and integer "
+            f"ops saturate/overflow silently; dequantize first "
+            f"(`.astype(jnp.float32)` / `.astype(jnp.int32)`, then "
+            f"apply the scale), or mark a blessed dequant site "
+            f"`# graftlint: lowprec(<why>)`")
+
+    def _check_quant_binop(self, ctx: ModuleContext, fn: ast.AST,
+                           scope: _FnScope, node: ast.BinOp) -> None:
+        for side in (node.left, node.right):
+            if _collapse(scope.lat_of(side)) == QUANT:
+                self._quant_flag(ctx, fn, node,
+                                 "a binary op on an un-dequantized "
+                                 "operand")
+                return
+
+    def _check_quant_call(self, ctx: ModuleContext, fn: ast.AST,
+                          scope: _FnScope, call: ast.Call) -> None:
+        la = _callee_name(call.func)
+        if la not in _REDUCTIONS and la not in _DOT_FAMILY \
+                and la not in ("exp", "exp2", "sqrt", "log", "log2"):
+            return
+        for arg in call.args:
+            if isinstance(arg, ast.Constant):
+                continue
+            if _collapse(scope.lat_of(arg)) == QUANT:
+                self._quant_flag(ctx, fn, call,
+                                 f"`{la}` over raw codes")
+                return
+
+
+def analyze_precision(contexts: List[ModuleContext]) -> List[Finding]:
+    """Run the precision analysis; returns every finding (all five
+    rules) unfiltered — the runner applies suppressions."""
+    return _Analysis(list(contexts)).run()
+
+
+# ------------------------------------------------------- program rules
+
+class _PrecisionRule(ProgramRule):
+    """Shared driver: the dtype-flow analysis runs once per program
+    (memoized on the Program object by :meth:`prepare`, timed under the
+    ``precision-pass`` row exactly like ``concurrency-pass``); each
+    registered rule yields its slice."""
+
+    shared_pass = "precision-pass"
+
+    def prepare(self, program) -> None:
+        if getattr(program, "_precision_findings", None) is None:
+            program._precision_findings = analyze_precision(
+                program.contexts)
+
+    def check_program(self, program) -> Iterator[Finding]:
+        self.prepare(program)
+        for finding in program._precision_findings:
+            if finding.rule == self.name:
+                yield finding
+
+
+@register_program
+class Bf16UnsafeReduction(_PrecisionRule):
+    """Rule P1 — reduction accumulated in a low-precision dtype.
+
+    ``softmax``/``logsumexp``/``mean``/``var``/``norm``-family calls
+    whose operand is inferred bf16/fp16 (or, in a Pallas kernel body,
+    follows a raw ``*_ref`` load — including contractions without
+    ``preferred_element_type=jnp.float32``), and mean-family reductions
+    in traced code with no fp32 anchor anywhere on the operand's flow.
+    Escapes: ``dtype=jnp.float32``, ``.astype(jnp.float32)`` upstream,
+    ``# graftlint: reduce-fp32``, justified ``lowprec(<why>)``.
+    """
+
+    name = "bf16-unsafe-reduction"
+    summary = ("softmax/mean/var/norm-family reduction accumulated in "
+               "a low-precision dtype (incl. Pallas accumulators)")
+
+
+@register_program
+class MasterWeightViolation(_PrecisionRule):
+    """Rule P2 — optimizer update touching non-fp32 master weights.
+
+    A call of a ``# graftlint: precision(master-fp32)``-marked function
+    passing a value inferred low/quant, ``optax.apply_updates`` on
+    low-precision params, or a master-fp32 function body downcasting a
+    parameter — the O2 discipline: updates land on fp32 masters, the
+    half copy exists only for the forward pass.
+    """
+
+    name = "master-weight-violation"
+    summary = ("optimizer update / weight decay applied to a non-fp32 "
+               "leaf where the master-fp32 contract applies")
+
+
+@register_program
+class UnscaledGradUse(_PrecisionRule):
+    """Rule P3 — gradients consumed between loss-scale and unscale.
+
+    In a function whose loss is multiplied by a loss scale
+    (``scale_loss`` / ``loss_scaler.scale``), the grads returned by
+    ``jax.grad``/``value_and_grad`` carry that scale until an
+    ``unscale`` (or ``apply_gradients``, which unscales internally);
+    feeding them to ``clip_grad_norm``/``global_norm``-family helpers
+    first computes clip thresholds that silently track the scale.
+    """
+
+    name = "unscaled-grad-use"
+    summary = ("grad norm/clip computed on still-scaled gradients "
+               "(between loss-scale multiply and unscale)")
+
+
+@register_program
+class RedundantCast(_PrecisionRule):
+    """Rule P4 — ``.astype(A).astype(B)`` chains.
+
+    The intermediate cast's result is dead: a hot-path perf smell, and
+    when it narrows (fp32 → bf16 → fp32) a silent precision round-trip.
+    A deliberate quantize-dequantize simulation is annotated
+    ``# graftlint: lowprec(<why>)``.
+    """
+
+    name = "redundant-cast"
+    summary = ("chained astype casts that round-trip precision / "
+               "materialize a dead intermediate (perf smell)")
+
+
+@register_program
+class QuantCodeArith(_PrecisionRule):
+    """Rule P5 — arithmetic on int8/fp8 quantization codes.
+
+    Values cast to int8/uint8/fp8 are *codes* (KV pages, quantized
+    AllReduce payloads): arithmetic on them outside a blessed dequant
+    site saturates/overflows silently and ignores the scale.  Widening
+    casts (``astype(int32)`` accumulate, ``astype(float32)`` dequant)
+    sanitize; structural ops (reshape/pad/collectives) are fine.
+    """
+
+    name = "quant-code-arith"
+    summary = ("arithmetic on int8/fp8 codes outside a blessed, "
+               "annotated dequant site")

@@ -56,6 +56,10 @@ SUBSET = [
     # strict instrumentation — on chip the worker/supervisor timing is
     # the honest interleaving the order recorder is meant to observe
     "tests/test_lockcheck.py",
+    # graftlint v3 runtime twin (ISSUE 10): the numerics sanitizer's
+    # unit tier — on chip the fp16 downcast-overflow and underflow
+    # paths run against real MXU/VPU rounding, not the CPU emulation
+    "tests/test_numcheck.py",
     "tests/test_chaos.py",
 ]
 
