@@ -32,6 +32,7 @@ def initialize(
     loss_scale: Any = "__unset__",
     keep_batchnorm_fp32: Any = "__unset__",
     master_weights: Any = "__unset__",
+    zero: Any = None,
     **policy_overrides: Any,
 ) -> MixedPrecisionTrainState:
     """Build a mixed-precision train state from an opt level.
@@ -40,6 +41,12 @@ def initialize(
     opt_level=..., loss_scale=..., keep_batchnorm_fp32=...,
     master_weights=...)`` — same override knobs, but returns a new pytree
     instead of mutating the inputs.
+
+    ``zero`` — a :class:`~apex_tpu.parallel.distributed_optim.
+    ZeroConfig` shards the fp32 masters and optimizer state over its
+    mesh axis (ZeRO-1/2; ``docs/zero.md``): the train step must then
+    run inside ``shard_map`` and feed *per-replica* grads to
+    ``apply_gradients``, which owns the reduce-scatter/all-gather.
     """
     import jax.numpy as jnp
 
@@ -63,7 +70,7 @@ def initialize(
         return [initialize(f, p, t, opt_level, half_dtype=half_dtype,
                            loss_scale=loss_scale,
                            keep_batchnorm_fp32=keep_batchnorm_fp32,
-                           master_weights=master_weights,
+                           master_weights=master_weights, zero=zero,
                            **policy_overrides)
                 for f, p, t in zip(fns, params, tx)]
 
@@ -77,7 +84,8 @@ def initialize(
     kw = {"half_dtype": half_dtype} if half_dtype is not None else {}
     policy = PrecisionPolicy.from_opt_level(opt_level, **kw, **overrides)
     return MixedPrecisionTrainState.create(
-        apply_fn=apply_fn, params=params, tx=tx, policy=policy)
+        apply_fn=apply_fn, params=params, tx=tx, policy=policy,
+        zero=zero)
 
 
 def scale_loss(loss: Any, state: MixedPrecisionTrainState) -> Any:

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import struct
+from jax import lax
 
 from apex_tpu.core.loss_scale import (
     DynamicLossScale,
@@ -37,6 +38,19 @@ class MixedPrecisionTrainState(struct.PyTreeNode):
     master weights, ``apex/fp16_utils/fp16_optimizer.py``) or when the
     policy is full-precision; otherwise in ``policy.param_dtype`` (O3).
     The forward pass should consume :meth:`compute_params`.
+
+    **ZeRO mode** (``zero=ZeroConfig(...)`` at :meth:`create`): the fp32
+    masters and the optimizer state live *sharded* over the ZeRO axis
+    (:class:`~apex_tpu.parallel.distributed_optim.ZeroOptState` in
+    ``opt_state``: ``(n, m)`` leaves, row ``i`` on shard ``i``), while
+    ``params`` hold the full replicated copy in ``policy.param_dtype``
+    (bf16 under O2) for the forward.  :meth:`apply_gradients` then owns
+    the whole ZeRO choreography — reduce-scatter (the gradient sync:
+    do NOT pre-``pmean``), shard-local update on the fp32 masters,
+    all-gather of the compute-dtype params — and must run inside
+    ``jax.shard_map`` over the ZeRO axis with
+    :func:`~apex_tpu.parallel.distributed_optim.zero_state_specs` as
+    the state's in/out specs.  See ``docs/zero.md``.
     """
 
     step: jnp.ndarray
@@ -47,6 +61,8 @@ class MixedPrecisionTrainState(struct.PyTreeNode):
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
     policy: PrecisionPolicy = struct.field(pytree_node=False)
     loss_scaler: DynamicLossScale = struct.field(pytree_node=False)
+    #: ZeRO-1/2 layout (parallel.distributed_optim.ZeroConfig) or None.
+    zero: Optional[Any] = struct.field(pytree_node=False, default=None)
 
     # ------------------------------------------------------------------ #
     @classmethod
@@ -58,9 +74,13 @@ class MixedPrecisionTrainState(struct.PyTreeNode):
         tx: optax.GradientTransformation,
         policy: Optional[PrecisionPolicy] = None,
         loss_scaler: Optional[DynamicLossScale] = None,
+        zero: Optional[Any] = None,
     ) -> "MixedPrecisionTrainState":
         policy = policy or PrecisionPolicy.O0()
         loss_scaler = loss_scaler or policy.make_loss_scale()
+        if zero is not None:
+            return cls._create_zero(apply_fn, params, tx, policy,
+                                    loss_scaler, zero)
         if policy.master_weights:
             stored = policy.master_params(params)     # fp32 masters
         else:
@@ -74,6 +94,51 @@ class MixedPrecisionTrainState(struct.PyTreeNode):
             tx=tx,
             policy=policy,
             loss_scaler=loss_scaler,
+        )
+
+    @classmethod
+    def _create_zero(cls, apply_fn, params, tx, policy, loss_scaler,
+                     zero) -> "MixedPrecisionTrainState":
+        """Note: like the non-zero ``create``, this materializes the
+        full ``(n, m)`` master/moment arrays on the default device
+        before ``jax.device_put(state, zero_shardings(state))``
+        commits the sharded placement — the create-time footprint is
+        the replicated one (fine wherever the replicated baseline fit,
+        which is this library's envelope today).  Creating directly
+        into shards (jit + out_shardings) is the known lever if a
+        model's state stops fitting one device at init.
+        """
+        # lazy import: parallel layers on core, not vice versa
+        from apex_tpu.parallel import distributed_optim as zero_lib
+
+        zero = zero.resolved()
+        n = zero.axis_size
+        # fp32 master shards — every ZeRO stage keeps the masters fp32
+        # (the `precision(master-fp32)` contract the update consumes),
+        # even under O0 where the replicated params are fp32 too: the
+        # shard is the authoritative copy the optimizer touches.
+        master = zero_lib.zero_partition(params, n, dtype=jnp.float32)
+        inner = tx.init(master)
+        for leaf in jax.tree.leaves(inner):
+            shape = jnp.shape(leaf)
+            if shape and shape[0] != n and jnp.size(leaf) > 1:
+                raise ValueError(
+                    f"optimizer state leaf of shape {shape} is not "
+                    f"shard-shaped (leading dim != axis_size={n}) — "
+                    f"this transform lays state across leaf "
+                    f"boundaries (e.g. fused_adam's fp8_block_scaled "
+                    f"moments); use a dense/elementwise state layout "
+                    f"with ZeRO")
+        return cls(
+            step=jnp.asarray(0, jnp.int32),
+            params=policy.cast_to_param(params),
+            opt_state=zero_lib.ZeroOptState(master=master, inner=inner),
+            loss_scale_state=loss_scaler.init(),
+            apply_fn=apply_fn,
+            tx=tx,
+            policy=policy,
+            loss_scaler=loss_scaler,
+            zero=zero,
         )
 
     # ------------------------------------------------------------------ #
@@ -94,7 +159,13 @@ class MixedPrecisionTrainState(struct.PyTreeNode):
         :meth:`compute_params` (possibly half precision).  Returns
         ``(new_state, grads_finite)`` — the flag stays on device; apex's
         overflow print becomes the caller's choice.
+
+        In ZeRO mode the *per-replica* grads go in as-is (no pmean —
+        the reduce-scatter IS the gradient sync) and the call must run
+        inside ``shard_map`` over the ZeRO axis.
         """
+        if self.zero is not None:
+            return self._apply_gradients_zero(grads=grads, **kwargs)
         ls, ls_state = self.loss_scaler, self.loss_scale_state
         # upcast half grads into the params' storage dtype (fp32 masters
         # under O2) BEFORE unscaling — the reference's multi_tensor_scale
@@ -120,6 +191,56 @@ class MixedPrecisionTrainState(struct.PyTreeNode):
             params=new_params,
             opt_state=new_opt_state,
             loss_scale_state=new_ls_state,
+        )
+        return new_state, finite
+
+    def _apply_gradients_zero(
+        self, *, grads: Any, **kwargs: Any
+    ) -> Tuple["MixedPrecisionTrainState", jnp.ndarray]:
+        """The ZeRO-1/2 step: reduce-scatter → shard-local update on
+        fp32 masters → all-gather compute-dtype params.
+
+        Runs inside ``shard_map`` over ``zero.axis``: the state's
+        master/opt leaves arrive as local ``(1, m)`` shard views
+        (in/out specs from ``zero_state_specs``), ``grads`` as this
+        replica's full-shape, un-synced gradients of the scaled loss.
+        """
+        from apex_tpu.parallel import distributed_optim as zero_lib
+
+        z = self.zero
+        zs = self.opt_state
+        ls, ls_state = self.loss_scaler, self.loss_scale_state
+        # gradient sync + shardization in one collective: scaled grads
+        # on the wire (the int8 amax discipline quantizes the scaled
+        # values, exactly like ddp's int8 all-reduce), fp32 shards out
+        # — so unscaling below never flushes tiny fp16 values
+        g_shards = zero_lib.reduce_scatter_mean_grads(
+            grads, z.axis, reduce_dtype=z.reduce_dtype, stage=z.stage)
+        g_shards = ls.unscale(ls_state, g_shards)
+        # step-or-skip must be one GLOBAL decision: a non-finite value
+        # lands only in its owning shard after the reduce-scatter, so
+        # the local flags disagree — pmin makes every shard skip iff
+        # any shard saw inf/nan
+        finite = lax.pmin(
+            all_finite(g_shards).astype(jnp.int32), z.axis
+        ).astype(jnp.bool_)
+        updates, new_inner = self.tx.update(
+            g_shards, zs.inner, zs.master, **kwargs)
+        new_master = optax.apply_updates(zs.master, updates)
+        new_master = tree_select(finite, new_master, zs.master)
+        new_inner = tree_select(finite, new_inner, zs.inner)
+        # all-gather in the STORAGE dtype (bf16 under O2): cast the
+        # 1/n-sized shard before the collective so the wire and the
+        # replicated copy both carry compute-width elements; only the
+        # resident master shard stays fp32
+        new_params = zero_lib.all_gather_params(
+            self.policy.cast_to_param(new_master), self.params, z.axis)
+        new_state = self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=zero_lib.ZeroOptState(master=new_master,
+                                            inner=new_inner),
+            loss_scale_state=ls.adjust(ls_state, finite),
         )
         return new_state, finite
 

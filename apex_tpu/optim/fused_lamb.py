@@ -45,6 +45,7 @@ def fused_lamb(
     max_grad_norm: Optional[float] = 1.0,
     trust_clip: bool = False,
     always_adapt: bool = False,
+    shard_axis: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """Build the FusedLAMB gradient transformation.
 
@@ -53,6 +54,13 @@ def fused_lamb(
     ratio at 1.  ``always_adapt=False`` (reference behavior): the trust
     ratio is only applied when ``weight_decay != 0`` for that group —
     here, globally.
+
+    ``shard_axis`` — set when the update runs on ZeRO shards inside
+    ``shard_map`` (:mod:`apex_tpu.parallel.distributed_optim`): the
+    global-norm clip and the per-tensor trust-ratio norms ``psum``
+    their squared sums over that mesh axis, so the shard-local update
+    is exactly the full-tensor one (the reference
+    ``distributed_fused_lamb``'s allreduced-L2 stage).
     """
 
     def init(params):
@@ -76,12 +84,14 @@ def fused_lamb(
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
-        # stage 0: fused global-norm clip (multi_tensor_l2norm + scale).
-        coef, _ = global_grad_clip_coef(grads, max_grad_norm)
+        # stage 0: fused global-norm clip (multi_tensor_l2norm + scale;
+        # with shard_axis the norm spans every ZeRO shard).
+        coef, _ = global_grad_clip_coef(grads, max_grad_norm,
+                                        axis=shard_axis)
 
         use_trust = always_adapt or weight_decay != 0.0
 
-        def leaf(g, p, m, v):
+        def leaf_pre(g, p, m, v):
             gf = g.astype(jnp.float32) * coef
             pf = p.astype(jnp.float32)
             if not adam_w_mode and weight_decay != 0.0:
@@ -91,9 +101,33 @@ def fused_lamb(
             upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
             if adam_w_mode and weight_decay != 0.0:
                 upd = upd + weight_decay * pf
+            return pf, upd, m_new, v_new
+
+        g_leaves, treedef = jax.tree.flatten(grads)
+        p_leaves = treedef.flatten_up_to(params)
+        m_leaves = treedef.flatten_up_to(state.exp_avg)
+        v_leaves = treedef.flatten_up_to(state.exp_avg_sq)
+        pre = [leaf_pre(g, p, m, v) for g, p, m, v
+               in zip(g_leaves, p_leaves, m_leaves, v_leaves)]
+
+        if use_trust and pre:
+            # per-tensor trust-ratio norms, batched: every leaf's
+            # w²/u² squared sum rides ONE stacked vector (and, under
+            # shard_axis, ONE psum — the reference's single fused
+            # allreduced-L2 stage, not 2 scalar collectives per leaf)
+            sq = jnp.stack(
+                [jnp.sum(jnp.square(pf)) for pf, _, _, _ in pre]
+                + [jnp.sum(jnp.square(upd)) for _, upd, _, _ in pre])
+            if shard_axis is not None:
+                sq = jax.lax.psum(sq, shard_axis)
+            norms = jnp.sqrt(sq)
+            n_leaves = len(pre)
+
+        triples = []
+        for i, (pf, upd, m_new, v_new) in enumerate(pre):
             if use_trust:
-                w_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
-                u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+                w_norm = norms[i]
+                u_norm = norms[n_leaves + i]
                 # reference: ratio = w/u when both > 0, else 1.0
                 ratio = jnp.where(
                     (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
@@ -101,15 +135,9 @@ def fused_lamb(
                     ratio = jnp.minimum(ratio, 1.0)
             else:
                 ratio = jnp.asarray(1.0, jnp.float32)
-            return ((-lr * ratio * upd).astype(p.dtype),
-                    m_new.astype(m.dtype), v_new.astype(v.dtype))
-
-        g_leaves, treedef = jax.tree.flatten(grads)
-        p_leaves = treedef.flatten_up_to(params)
-        m_leaves = treedef.flatten_up_to(state.exp_avg)
-        v_leaves = treedef.flatten_up_to(state.exp_avg_sq)
-        triples = [leaf(g, p, m, v) for g, p, m, v
-                   in zip(g_leaves, p_leaves, m_leaves, v_leaves)]
+            p, m, v = p_leaves[i], m_leaves[i], v_leaves[i]
+            triples.append(((-lr * ratio * upd).astype(p.dtype),
+                            m_new.astype(m.dtype), v_new.astype(v.dtype)))
         updates = treedef.unflatten([t[0] for t in triples])
         exp_avg = treedef.unflatten([t[1] for t in triples])
         exp_avg_sq = treedef.unflatten([t[2] for t in triples])

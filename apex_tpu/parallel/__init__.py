@@ -17,10 +17,17 @@ from apex_tpu.parallel.sync_batchnorm import (
     convert_syncbn_model,
 )
 from apex_tpu.parallel.distributed_optim import (
+    ZeroConfig,
+    ZeroOptState,
+    all_gather_params,
     distributed_fused_adam,
     distributed_fused_lamb,
+    reduce_scatter_mean_grads,
     zero_param_specs,
+    zero_partition,
     zero_shardings,
+    zero_state_specs,
+    zero_unpartition,
 )
 from apex_tpu.parallel.ring_attention import (
     ring_attention,
@@ -42,8 +49,11 @@ __all__ = [
     "DistributedDataParallel", "replicate", "shard_batch",
     "all_reduce_mean_grads",
     "SyncBatchNorm", "sync_batch_norm_stats", "convert_syncbn_model",
+    "ZeroConfig", "ZeroOptState",
     "distributed_fused_adam", "distributed_fused_lamb",
-    "zero_param_specs", "zero_shardings",
+    "zero_partition", "zero_unpartition",
+    "reduce_scatter_mean_grads", "all_gather_params",
+    "zero_param_specs", "zero_shardings", "zero_state_specs",
     "ring_attention", "ring_self_attention",
     "ulysses_attention", "ulysses_self_attention",
     "LARC",

@@ -83,6 +83,61 @@ def _is_dtype_like(x) -> bool:
         return False
 
 
+def _q8_inv_scale_for(amax):
+    """(scale, 1/scale) for the int8 amax discipline; scale == 0 means
+    "all-zero payload" and dequantizes to exact 0.
+
+    Guards against near-zero amax: 127/amax overflows to +inf for
+    amax < 127/float32_max (~3.7e-37) and then 0*inf = NaN poisons
+    zero grads.  Shared by the EQuARX-style all-reduce below and the
+    ZeRO quantized reduce-scatter
+    (:mod:`apex_tpu.parallel.distributed_optim`) — same scale
+    discipline, one implementation.
+    """
+    tiny = 127.0 / jnp.finfo(jnp.float32).max
+    ok = amax > tiny
+    safe = jnp.maximum(amax, tiny)
+    return (jnp.where(ok, 127.0 / safe, 0.0),
+            jnp.where(ok, safe / 127.0, 0.0))
+
+
+def _pad_rows(flat, n: int):
+    """``(n, ceil(size/n))`` shard-row layout: row ``i`` is shard
+    ``i``'s slice, zero-padded.  THE layout contract shared by the
+    reduce-scatter legs here and ``distributed_optim``'s
+    ``zero_partition`` master shards — one implementation so the
+    gradient chunks can never desynchronize from the master rows."""
+    m = -(-max(1, flat.size) // n)
+    return jnp.pad(flat, (0, m * n - flat.size)).reshape(n, m)
+
+
+def _q8_reduce_scatter(g, axis: str, n: int):
+    """Reduce-scatter leg of the EQuARX int8 collective: quantize ``g``
+    against its global amax, exchange int8 chunks via ``all_to_all``
+    (1 byte/element on the wire), accumulate locally in int32 (no
+    overflow for < 2^24 replicas).
+
+    Returns ``(s, inv_scale, amax)`` where ``s`` is this device's
+    int32 partial-sum chunk of shape ``(ceil(g.size/n),)``.  Callers:
+    :func:`all_reduce_mean_grads` (requantizes ``s`` and all-gathers —
+    the full all-reduce) and the ZeRO grad reduce-scatter in
+    :mod:`~apex_tpu.parallel.distributed_optim` (dequantizes ``s``
+    shard-locally — the chunk IS the destination).
+    """
+    amax = lax.pmax(jnp.max(jnp.abs(g)).astype(jnp.float32), axis)
+    scale, inv_scale = _q8_inv_scale_for(amax)
+    q = jnp.clip(jnp.round(g.astype(jnp.float32) * scale),
+                 -127, 127).astype(jnp.int8)
+    # int8 on the wire.  all_to_all hands every device all n replicas
+    # of its owned chunk; the sum happens on-chip in int32
+    # (psum_scatter would accumulate in the wire dtype and overflow at
+    # int8).
+    mine = lax.all_to_all(_pad_rows(q.ravel(), n), axis,
+                          split_axis=0, concat_axis=0, tiled=True)
+    s = jnp.sum(mine.astype(jnp.int32), axis=0)
+    return s, inv_scale, amax
+
+
 def all_reduce_mean_grads(grads: Any, axis: str = DATA_AXIS, *,
                           allreduce_dtype: Any = None,
                           average: bool = True) -> Any:
@@ -116,41 +171,15 @@ def all_reduce_mean_grads(grads: Any, axis: str = DATA_AXIS, *,
         return jax.tree.map(lambda g: reduce(g, axis), grads)
     if dtype == "int8":
         n = lax.axis_size(axis)
-        # guard against near-zero amax: 127/amax overflows to +inf for
-        # amax < 127/float32_max (~3.7e-37) and then 0*inf = NaN
-        # poisons zero grads
-        tiny = 127.0 / jnp.finfo(jnp.float32).max
-
-        def inv_scale_for(amax):
-            """(scale, 1/scale) with the near-zero guard; scale == 0
-            means "all-zero payload" and dequantizes to exact 0."""
-            ok = amax > tiny
-            safe = jnp.maximum(amax, tiny)
-            return (jnp.where(ok, 127.0 / safe, 0.0),
-                    jnp.where(ok, safe / 127.0, 0.0))
 
         def q8(g):
-            amax = lax.pmax(jnp.max(jnp.abs(g)).astype(jnp.float32),
-                            axis)
-            scale, inv_scale = inv_scale_for(amax)
-            q = jnp.clip(jnp.round(g.astype(jnp.float32) * scale),
-                         -127, 127).astype(jnp.int8)
-            # reduce-scatter leg: int8 on the wire.  all_to_all hands
-            # every device all n replicas of its owned chunk; the sum
-            # happens on-chip in int32 (psum_scatter would accumulate
-            # in the wire dtype and overflow at int8).
-            flat = q.ravel()
-            m = -(-flat.size // n)
-            flat = jnp.pad(flat, (0, m * n - flat.size))
-            mine = lax.all_to_all(flat.reshape(n, m), axis,
-                                  split_axis=0, concat_axis=0,
-                                  tiled=True)
-            s = jnp.sum(mine.astype(jnp.int32), axis=0)
+            # reduce-scatter leg (shared with the ZeRO path)
+            s, inv_scale, amax = _q8_reduce_scatter(g, axis, n)
             # all-gather leg: requantize the int32 partial sums (|s| ≤
             # 127n) against their global amax so the gather is int8 too
             s_amax = lax.pmax(jnp.max(jnp.abs(s)).astype(jnp.float32),
                               axis)
-            rscale, inv_rscale = inv_scale_for(s_amax)
+            rscale, inv_rscale = _q8_inv_scale_for(s_amax)
             r = jnp.clip(jnp.round(s.astype(jnp.float32) * rscale),
                          -127, 127).astype(jnp.int8)
             full = lax.all_gather(r, axis, tiled=True)

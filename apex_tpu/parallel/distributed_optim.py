@@ -1,50 +1,315 @@
-"""Distributed ("ZeRO"-sharded) fused optimizers.
+"""ZeRO-1/2: optimizer state sharded across the data-parallel group.
 
 Reference: ``apex/contrib/optimizers/distributed_fused_adam.py`` /
 ``distributed_fused_lamb.py`` — optimizer state and master params
 sharded across the DP group; gradients reduce-scattered into shards
 during backward (bucketed, overlapped), updated shard-locally, params
-all-gathered after the step (SURVEY.md §2.7).
+all-gathered after the step (SURVEY.md §2.7) — and "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training"
+(PAPERS.md, arxiv 2004.13336), whose GSPMD formulation this module
+implements directly:
 
-TPU translation: the reduce-scatter/all-gather choreography IS the
-GSPMD lowering of "optimizer state sharded over the ``fsdp`` axis" —
-XLA inserts a reduce-scatter for the grads feeding sharded state, runs
-the (already fused, :mod:`apex_tpu.optim`) update shard-locally, and
-all-gathers params where the forward needs them, overlapping both with
-compute.  So the distributed variants are *placement policies* over the
-same transforms:
+1. **reduce-scatter** the gradients over the ZeRO axis — each device
+   receives only the shard of the (mean) gradient it owns.  The wire
+   lever composes with the PR-8 int8 quantized-collective machinery in
+   :mod:`apex_tpu.parallel.ddp` (``reduce_dtype="int8"`` rides the
+   same amax/scale discipline and ``all_to_all`` leg; a half dtype
+   halves the wire bytes; ``None`` reduce-scatters exactly in fp32).
+2. **shard-local update** — the existing fused optimizers
+   (:mod:`apex_tpu.optim`) run unchanged on fp32 **master shards**
+   carrying the machine-checked ``precision(master-fp32)`` contract:
+   elementwise updates (Adam/SGD/Adagrad) are shard-exact by
+   construction; LAMB takes a ``shard_axis`` so its per-tensor norms
+   ``psum`` across shards (:func:`distributed_fused_lamb`).  LARC has
+   no shard-aware variant yet — its per-leaf trust ratios would be
+   silently shard-local; don't chain it into a ZeRO update.
+3. **all-gather** the updated params in the *compute/storage* dtype
+   (bf16 under O2 — half the gather bytes of fp32) for the next
+   forward.
 
-    tx = distributed_fused_adam(lr)            # == fused_adam
-    shardings = zero_shardings(mesh, params)   # state/master specs
-    train_step = jit(step, in_shardings=(shardings.state, ...))
+What each stage buys (per chip, n-way sharding, P params):
 
-``zero_shardings`` computes per-leaf PartitionSpecs that shard the
-*largest* dim of each ≥1-D leaf over ``fsdp`` (ZeRO-1/2 equivalent);
-scalars stay replicated.
+- **ZeRO-1** (``stage=1``): optimizer state (fp32 masters + both Adam
+  moments, 12 B/param replicated) shrinks to ``12/n`` B/param; the
+  gradient sync stays a full all-reduce and the full mean gradient is
+  materialized before slicing.
+- **ZeRO-2** (``stage=2``, default): same state sharding, but the
+  gradients are reduce-scattered — the full unscaled fp32 gradient
+  buffer never materializes; each device only ever holds its
+  ``P/n``-element shard.  This is the ``temp``-HBM lever the bench
+  roofline identifies (``_zero_bytes_on_wire`` in ``bench_configs``
+  models both wire and resident bytes).
+
+The choreography lives in
+:meth:`apex_tpu.core.train_state.MixedPrecisionTrainState.apply_gradients`
+(zero mode): pass ``zero=ZeroConfig(...)`` to ``amp.initialize`` /
+``MixedPrecisionTrainState.create`` and run the train step inside
+``jax.shard_map`` with :func:`zero_state_specs` as the state's
+in/out specs.  Placement of the sharded state on the mesh — and the
+restore target for :class:`~apex_tpu.resilience.ResilientCheckpointer`
+— comes from :func:`zero_shardings`.  See ``docs/zero.md``.
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+import dataclasses
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec
 
 from apex_tpu.core import mesh as mesh_lib
 from apex_tpu.core.mesh import FSDP_AXIS
 from apex_tpu.optim import fused_adam, fused_lamb
+from apex_tpu.parallel import ddp as _ddp
 
 __all__ = [
+    "ZeroConfig",
+    "ZeroOptState",
     "distributed_fused_adam",
     "distributed_fused_lamb",
+    "zero_partition",
+    "zero_unpartition",
+    "reduce_scatter_mean_grads",
+    "all_gather_params",
+    "zero_state_specs",
     "zero_param_specs",
     "zero_shardings",
 ]
 
-# The transforms are identical — distribution is placement, not math.
-distributed_fused_adam = fused_adam
-distributed_fused_lamb = fused_lamb
+
+# ------------------------------------------------------------- configuration
+
+@dataclasses.dataclass(frozen=True)
+class ZeroConfig:
+    """Static description of a ZeRO-sharded optimizer layout.
+
+    Stored as a non-pytree field on
+    :class:`~apex_tpu.core.train_state.MixedPrecisionTrainState`, so it
+    must stay hashable.
+
+    ``axis`` — mesh axis the state shards over (and the grads
+    reduce-scatter over); the canonical choice is ``"fsdp"``, but any
+    data-parallel axis works (the simple example uses ``"data"``).
+    ``stage`` — 1 (all-reduce grads, slice locally) or 2
+    (reduce-scatter; the full gradient never materializes).
+    ``reduce_dtype`` — wire dtype of the grad sync: ``None`` (exact,
+    fp32), a half dtype, or ``"int8"`` (the EQuARX amax/scale
+    discipline shared with :func:`~apex_tpu.parallel.ddp.
+    all_reduce_mean_grads`).
+    ``axis_size`` — number of shards; ``0`` resolves from the current
+    :func:`~apex_tpu.core.mesh.get_mesh` at ``create`` time (pass it
+    explicitly when training over a raw, unregistered mesh).
+    """
+
+    axis: str = FSDP_AXIS
+    stage: int = 2
+    reduce_dtype: Any = None
+    axis_size: int = 0
+
+    def resolved(self, mesh=None) -> "ZeroConfig":
+        """Validate and fill ``axis_size`` from the mesh if unset."""
+        if self.stage not in (1, 2):
+            raise ValueError(f"ZeRO stage must be 1 or 2, got "
+                             f"{self.stage!r}")
+        # reuse ddp's normalization so an int dtype fails loudly here
+        _ddp._normalize_allreduce_dtype(self.reduce_dtype)
+        n = self.axis_size
+        if not n:
+            mesh = mesh or mesh_lib.get_mesh()
+            n = mesh.shape.get(self.axis, 0)
+            if not n:
+                raise ValueError(
+                    f"mesh has no axis {self.axis!r} — name a mesh "
+                    f"axis or pass axis_size explicitly")
+        if n < 1:
+            raise ValueError(f"axis_size must be >= 1, got {n}")
+        return dataclasses.replace(self, axis_size=int(n))
+
+
+class ZeroOptState(NamedTuple):
+    """The sharded ``opt_state`` of a zero-mode train state.
+
+    ``master`` — fp32 master shards, one ``(n, m)`` leaf per param
+    leaf (row ``i`` lives on shard ``i``; ``m = ceil(size / n)``,
+    zero-padded).  ``inner`` — the wrapped optimizer's state over the
+    master-shard tree (Adam moments etc. inherit the ``(n, m)``
+    layout, so they shard with the masters).
+    """
+
+    master: Any
+    inner: Any
+
+
+# ---------------------------------------------------------- shard layout
+
+def zero_partition(tree: Any, axis_size: int, *,
+                   dtype: Any = jnp.float32) -> Any:
+    """Stack every leaf into ``(axis_size, m)`` ZeRO shards.
+
+    Each floating leaf is flattened, cast to ``dtype`` (fp32 — the
+    master copy), zero-padded to a multiple of ``axis_size`` and
+    reshaped so row ``i`` is shard ``i``'s slice (the
+    ``ddp._pad_rows`` layout the reduce-scatter legs share).
+    Non-floating leaves keep their dtype.  The tree structure is
+    preserved, so pytree paths (and the policy's norm-layer filters)
+    still apply.
+    """
+    n = int(axis_size)
+
+    def part(p):
+        x = jnp.ravel(jnp.asarray(p))
+        if dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(dtype)
+        return _ddp._pad_rows(x, n)
+
+    return jax.tree.map(part, tree)
+
+
+def zero_unpartition(shards: Any, like: Any) -> Any:
+    """Inverse of :func:`zero_partition`: drop padding, restore shapes.
+
+    ``like`` supplies the original shapes; dtypes stay the shards'
+    (cast with the precision policy afterwards if needed).
+    """
+    def un(s, p):
+        shape = jnp.shape(p)
+        size = 1
+        for d in shape:
+            size *= d
+        return s.reshape(-1)[:size].reshape(shape)
+
+    return jax.tree.map(un, shards, like)
+
+
+# ------------------------------------------------------------- collectives
+
+def reduce_scatter_mean_grads(grads: Any, axis: str = FSDP_AXIS, *,
+                              reduce_dtype: Any = None,
+                              stage: int = 2,
+                              average: bool = True) -> Any:
+    """Reduce-scatter gradients into ``(1, m)`` fp32 shards (inside
+    ``shard_map``) — the ZeRO gradient sync.
+
+    Per leaf, the result is this device's row of the
+    :func:`zero_partition` layout of the mean (or summed) gradient, in
+    fp32 — ready to feed a shard-local fused-optimizer update against
+    the matching master shard.
+
+    ``stage=2`` (default) exchanges only shards: an ``all_to_all``
+    hands every device the n contributions to its chunk, summed
+    on-chip in fp32 — the full gradient never materializes.  With
+    ``reduce_dtype="int8"`` the exchange is the 1-byte/element
+    reduce-scatter leg of :func:`~apex_tpu.parallel.ddp.
+    all_reduce_mean_grads`'s EQuARX path (same amax/scale discipline,
+    shared implementation); non-finite grads poison the shard with NaN
+    so dynamic-loss-scale overflow detection still fires.  A half
+    ``reduce_dtype`` puts 2-byte elements on the wire and accumulates
+    in fp32.
+
+    ``stage=1`` all-reduces the full gradient (via
+    :func:`~apex_tpu.parallel.ddp.all_reduce_mean_grads`, honoring the
+    same ``reduce_dtype`` lever) and slices the local shard — more
+    resident bytes (the full mean gradient exists on every device),
+    kept for the ZeRO-1 memory/simplicity point of the design space.
+    """
+    dtype = _ddp._normalize_allreduce_dtype(reduce_dtype)
+    n = lax.axis_size(axis)
+    if stage not in (1, 2):
+        raise ValueError(f"stage must be 1 or 2, got {stage!r}")
+
+    if stage == 1:
+        full = _ddp.all_reduce_mean_grads(
+            grads, axis, allreduce_dtype=reduce_dtype, average=average)
+
+        def slice_own(g):
+            rows = _ddp._pad_rows(jnp.ravel(g).astype(jnp.float32), n)
+            return lax.dynamic_slice_in_dim(
+                rows, lax.axis_index(axis), 1, axis=0)
+
+        return jax.tree.map(slice_own, full)
+
+    def rs(g):
+        if dtype == "int8":
+            s, inv_scale, amax = _ddp._q8_reduce_scatter(g, axis, n)
+            deq = s.astype(jnp.float32) * inv_scale
+            if average:
+                deq = deq / n
+            # inf/nan grads must not be masked to zero: overflow
+            # detection (DynamicLossScale) keys off non-finite grads
+            deq = jnp.where(jnp.isfinite(amax), deq, jnp.nan)
+            return deq.reshape(1, -1)
+        wire = g if dtype is None else g.astype(dtype)
+        mine = lax.all_to_all(_ddp._pad_rows(jnp.ravel(wire), n), axis,
+                              split_axis=0, concat_axis=0, tiled=True)
+        # accumulate the n contributions in fp32 regardless of the
+        # wire dtype — a bf16 wire must not mean a bf16 running sum
+        s = jnp.sum(mine.astype(jnp.float32), axis=0)
+        if average:
+            s = s / n
+        return s.reshape(1, -1)
+
+    return jax.tree.map(rs, grads)
+
+
+def all_gather_params(shards: Any, like: Any,
+                      axis: str = FSDP_AXIS) -> Any:
+    """All-gather ``(1, m)`` shards back into full param leaves
+    (inside ``shard_map``).
+
+    The gather runs in the shards' dtype — cast to the compute/storage
+    dtype *before* calling (bf16 under O2) so the wire carries 2-byte
+    elements; only the resident master shard stays fp32.  ``like``
+    supplies the original shapes.
+    """
+    def ag(s, p):
+        full = lax.all_gather(s.reshape(-1), axis, tiled=True)
+        shape = jnp.shape(p)
+        size = 1
+        for d in shape:
+            size *= d
+        return full[:size].reshape(shape)
+
+    return jax.tree.map(ag, shards, like)
+
+
+# ------------------------------------------------- placement (load-bearing)
+
+def _is_zero_state(tree: Any) -> bool:
+    from apex_tpu.core.train_state import MixedPrecisionTrainState
+    return isinstance(tree, MixedPrecisionTrainState) \
+        and getattr(tree, "zero", None) is not None
+
+
+def zero_state_specs(state: Any) -> Any:
+    """Per-leaf ``PartitionSpec`` tree for a zero-mode train state.
+
+    Master/optimizer shards (the ``(n, m)`` leaves of
+    :class:`ZeroOptState`) get ``P(axis)`` on their shard dim;
+    everything else — params, step, loss-scale state, scalar counters
+    — is replicated.  This is both the ``shard_map`` in/out spec for
+    the train step and (via :func:`zero_shardings`) the committed
+    placement / checkpoint-restore target.
+    """
+    if not _is_zero_state(state):
+        raise ValueError("zero_state_specs expects a MixedPrecision"
+                         "TrainState created with zero=ZeroConfig(...)")
+    z = state.zero
+    replicated = jax.tree.map(lambda _: PartitionSpec(), state)
+
+    def shard_spec(leaf):
+        # static shape metadata only — every ZeroOptState array leaf is
+        # (axis_size, m) by construction; scalars (the step counter)
+        # stay replicated
+        if leaf.ndim >= 1 and leaf.shape[0] == z.axis_size:
+            spec = [z.axis] + [None] * (leaf.ndim - 1)
+            return PartitionSpec(*spec)
+        return PartitionSpec()
+
+    return replicated.replace(
+        opt_state=jax.tree.map(shard_spec, state.opt_state))
 
 
 def _leaf_spec(leaf, axis: str, axis_size: int) -> PartitionSpec:
@@ -63,16 +328,75 @@ def _leaf_spec(leaf, axis: str, axis_size: int) -> PartitionSpec:
 
 def zero_param_specs(params: Any, *, axis: str = FSDP_AXIS,
                      mesh=None) -> Any:
-    """Per-leaf PartitionSpecs sharding each tensor over ``fsdp``."""
+    """Per-leaf PartitionSpecs sharding each tensor over ``fsdp``
+    (generic largest-divisible-dim heuristic, for plain pytrees)."""
     mesh = mesh or mesh_lib.get_mesh()
     n = mesh.shape.get(axis, 1)
     return jax.tree.map(lambda p: _leaf_spec(p, axis, n), params)
 
 
-def zero_shardings(tree: Any, *, axis: str = FSDP_AXIS, mesh=None) -> Any:
-    """Per-leaf NamedShardings for params/opt-state pytrees (apply with
-    ``jax.device_put`` or as ``jit`` in/out shardings)."""
+def zero_shardings(tree: Any, *, axis: str = FSDP_AXIS,
+                   mesh=None) -> Any:
+    """Per-leaf ``NamedSharding``\\ s for ZeRO placement.
+
+    Two modes:
+
+    - a **zero-mode** :class:`~apex_tpu.core.train_state.
+      MixedPrecisionTrainState` → the exact state placement
+      (:func:`zero_state_specs` over the mesh): master/opt shards on
+      their ZeRO axis, everything else replicated.  Apply with
+      ``jax.device_put`` after ``create`` to commit the layout, and
+      build the :class:`~apex_tpu.resilience.ResilientCheckpointer`
+      restore target the same way — orbax restores arrays with the
+      target's shardings, so a resumed run lands exactly where a fresh
+      one does.
+    - any other pytree → the generic largest-divisible-dim heuristic
+      per leaf (the pre-ZeRO behavior, kept for raw param trees).
+    """
     mesh = mesh or mesh_lib.get_mesh()
-    specs = zero_param_specs(tree, axis=axis, mesh=mesh)
+    if _is_zero_state(tree):
+        specs = zero_state_specs(tree)
+    else:
+        specs = zero_param_specs(tree, axis=axis, mesh=mesh)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+# ------------------------------------------------- distributed optimizers
+
+def distributed_fused_adam(*args: Any, **kwargs: Any):
+    """:func:`~apex_tpu.optim.fused_adam` for ZeRO-sharded state.
+
+    The Adam update is elementwise, so the shard-local update on
+    ``(1, m)`` master shards is *exactly* the full update restricted
+    to the shard — the transform itself needs no distribution
+    awareness; the sharding is carried by the
+    :class:`ZeroOptState` layout and the reduce-scatter/all-gather
+    choreography in ``apply_gradients``.  (Reference:
+    ``apex/contrib/optimizers/distributed_fused_adam.py``.)
+
+    Note: ``moment_format="fp8_block_scaled"`` lays its quantization
+    blocks over the *flattened full leaf* and is rejected at
+    ``create`` time for zero states (the state is not shard-shaped);
+    use ``moment_dtype`` for reduced-precision sharded moments.
+    """
+    return fused_adam(*args, **kwargs)
+
+
+def distributed_fused_lamb(*args: Any, shard_axis: Optional[str],
+                           **kwargs: Any):
+    """:func:`~apex_tpu.optim.fused_lamb` for ZeRO-sharded state.
+
+    LAMB is *not* elementwise: the global-norm grad clip and the
+    per-tensor trust ratios need whole-tensor L2 norms, which a shard
+    only sees ``1/n`` of.  ``shard_axis`` (keyword-REQUIRED: pass the
+    :class:`ZeroConfig` axis you train over — a wrong default would
+    either fail at trace time or silently compute shard-local trust
+    ratios) makes every norm a ``psum`` across the shards, batched
+    into one collective — the reference ``distributed_fused_lamb``'s
+    allreduced-L2 stage — so the sharded update is exactly the full
+    one.  (Padding rows are zero and contribute nothing to the
+    norms.)  ``shard_axis=None`` is the plain :func:`fused_lamb` for
+    GSPMD-placed, unsharded-update flows.
+    """
+    return fused_lamb(*args, shard_axis=shard_axis, **kwargs)

@@ -38,7 +38,11 @@ Violations (strict mode; recorded, never raised at the fault site —
 - **master-weight violation** (static twin: ``master-weight-violation``)
   — ``apply_gradients`` on a state whose policy demands fp32 masters
   (``master_weights=True``) while a floating param leaf is not fp32.
-  Checked at trace time, so every compiled variant is covered.
+  Checked at trace time, so every compiled variant is covered.  In
+  ZeRO mode (``state.zero`` set) the contract moves with the masters:
+  the *sharded* ``ZeroOptState.master`` leaves must be fp32 (recorded
+  at the ``apply_gradients.master_shards`` site), while half
+  replicated params are the design, not a violation.
 - **downcast overflow** (static twin: ``redundant-cast`` /
   ``bf16-unsafe-reduction`` territory) — a cast boundary turning
   finite fp32 values into non-finite fp16 (bf16 shares fp32's
@@ -344,7 +348,32 @@ def _apply_gradients_wrapper(orig):
     def wrapped(self, *, grads, **kwargs):
         _recorder.record_dtypes("apply_gradients.grads", grads)
         _recorder.record_dtypes("apply_gradients.params", self.params)
-        if _strict and self.policy.master_weights:
+        zero = getattr(self, "zero", None)
+        if zero is not None:
+            # ZeRO mode: the fp32 masters live SHARDED in the opt
+            # state (ZeroOptState.master) while self.params are the
+            # replicated compute/storage-dtype copy — half params are
+            # the design here, not a violation; the master-fp32
+            # contract moves to the shards
+            master = getattr(self.opt_state, "master", None)
+            _recorder.record_dtypes("apply_gradients.master_shards",
+                                    master)
+            if _strict:
+                bad = sorted({
+                    jnp.dtype(l.dtype).name
+                    for l in _float_leaves(master)
+                    if jnp.dtype(l.dtype) != jnp.float32})
+                if bad:
+                    _recorder.report(
+                        ("master-shards", tuple(bad)),
+                        f"ZeRO optimizer step on non-fp32 master "
+                        f"shards: leaves are {bad} — the shard-local "
+                        f"update must land on fp32 masters "
+                        f"(ZeroOptState.master); half-precision "
+                        f"shards lose every increment below the "
+                        f"storage dtype's precision (static twin: "
+                        f"master-weight-violation)")
+        elif _strict and self.policy.master_weights:
             bad = sorted({
                 jnp.dtype(l.dtype).name for l in _float_leaves(self.params)
                 if jnp.dtype(l.dtype) != jnp.float32})

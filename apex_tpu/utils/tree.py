@@ -30,18 +30,30 @@ def is_floating(x: Any) -> bool:
     return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
 
 
-def tree_l2_norm(tree: Any, *, dtype=jnp.float32) -> jnp.ndarray:
-    """Global L2 norm over all floating leaves (``amp_C.multi_tensor_l2norm``)."""
+def tree_l2_norm(tree: Any, *, dtype=jnp.float32,
+                 axis: Optional[str] = None) -> jnp.ndarray:
+    """Global L2 norm over all floating leaves (``amp_C.multi_tensor_l2norm``).
+
+    ``axis`` — a mesh axis name the leaves are ZeRO-sharded over
+    (inside ``shard_map``): the squared sum is ``psum``'d across the
+    shards before the sqrt, so the sharded norm equals the full-tensor
+    one (the reference ``distributed_fused_lamb``'s allreduced-L2
+    stage; zero-padded shard rows contribute nothing).
+    """
     leaves = [l for l in jax.tree.leaves(tree) if is_floating(l)]
     if not leaves:
         return jnp.zeros((), dtype)
     sq = sum(jnp.sum(jnp.square(l.astype(dtype))) for l in leaves)
+    if axis is not None:
+        sq = jax.lax.psum(sq, axis)
     return jnp.sqrt(sq)
 
 
 def per_tensor_l2_norms(tree: Any, *, dtype=jnp.float32) -> Any:
     """Per-leaf L2 norms (``multi_tensor_l2norm(..., per_tensor=True)``),
-    used by LAMB's trust ratio and LARC."""
+    used by LAMB's trust ratio and LARC.  (Shard-local: the ZeRO-aware
+    per-tensor norms live in ``fused_lamb(shard_axis=...)``, which
+    batches every leaf's squared sum into one stacked ``psum``.)"""
     return jax.tree.map(
         lambda l: jnp.sqrt(jnp.sum(jnp.square(l.astype(dtype)))), tree)
 
@@ -66,13 +78,16 @@ def tree_select(pred: jnp.ndarray, new: Any, old: Any) -> Any:
 
 
 def global_grad_clip_coef(
-    grads: Any, max_norm: Optional[float], *, eps: float = 1e-6
+    grads: Any, max_norm: Optional[float], *, eps: float = 1e-6,
+    axis: Optional[str] = None
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Global-norm clip coefficient (``apex/contrib/clip_grad`` semantics).
 
-    Returns ``(coef, global_norm)``; ``coef`` is 1 when no clipping needed.
+    Returns ``(coef, global_norm)``; ``coef`` is 1 when no clipping
+    needed.  ``axis`` — ZeRO shard axis for the norm (see
+    :func:`tree_l2_norm`).
     """
-    gnorm = tree_l2_norm(grads)
+    gnorm = tree_l2_norm(grads, axis=axis)
     if max_norm is None:
         return jnp.ones((), jnp.float32), gnorm
     coef = jnp.minimum(1.0, max_norm / (gnorm + eps))

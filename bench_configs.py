@@ -17,6 +17,10 @@ Legs (reference workloads per BASELINE.json):
   bert_o1            BERT-Large, amp O1 interceptor + FusedAdam, +
                      grad-sync bytes-on-wire model and the measured
                      bert_o1_ddp int8-allreduce A/B child (ROADMAP 2b)
+  bert_o1_zero       ZeRO-2 A/B child (ISSUE 11): replicated vs
+                     sharded optimizer state at O2 — hbm_peak +
+                     state-bytes drop, grown-batch samples/sec, and
+                     the _zero_bytes_on_wire wire/residency model
   gpt2_1p3b          GPT-2 1.3B-family single-chip proxy    (configs[3])
                      (BENCH_GPT_VARIANT: base/noselect/fused_cast —
                      the round-5 optimizer-overlap experiment)
@@ -1334,6 +1338,16 @@ def bench_bert_o1():
                           + " --xla_force_host_platform_device"
                             "_count=8").strip(),
         }, timeout=1500)
+    if os.environ.get("BENCH_BERT_ZERO", "1") != "0":
+        # ISSUE-11 companion: replicated-vs-ZeRO-2 optimizer-state A/B
+        # on the same virtual mesh (hbm_peak drop, grown-batch row)
+        out["zero_ab"] = _run_child("bert_o1_zero", {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": None,
+            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device"
+                            "_count=8").strip(),
+        }, timeout=1500)
     _emit(out)
 
 
@@ -1534,6 +1548,282 @@ def bench_bert_o1_ddp():
                  "test_loss_trajectory's exact-vs-int8 band test; the "
                  "CPU wall ratio prices quantize arithmetic, not ICI "
                  "— the on-chip win follows the bytes model"),
+    })
+
+
+def _zero_bytes_on_wire(n_params, shards, *, stage=2,
+                        reduce_dtype="fp32", param_bytes=2,
+                        opt_bytes_per_param=12, scale_stages=1):
+    """Analytic wire + resident-state model for the ZeRO step
+    (ISSUE 11), extending :func:`_ddp_bytes_on_wire`:
+
+    **wire, per replica per step** — a reduce-scatter (or all-gather)
+    moves ``(n-1)/n × n_params`` elements; the ZeRO-2 step is one
+    reduce-scatter of grads (element width set by ``reduce_dtype``:
+    fp32 4 B, bf16 2 B, int8 1 B + ``scale_stages`` scalar amax pmax
+    collectives) plus one all-gather of params at ``param_bytes``
+    (bf16 under O2).  ZeRO-1 runs the full ``_ddp_bytes_on_wire``
+    all-reduce instead of the reduce-scatter.  The DP baseline is the
+    fp32 all-reduce: ``2 (n-1)/n × 4 × n_params``.
+
+    **resident, per chip** — where the bytes *live* (the HBM lever):
+    DP-O2 keeps fp32 masters + both Adam moments replicated
+    (``opt_bytes_per_param`` = 12 B/param; the bf16 forward copy is a
+    temp either way), ZeRO keeps a bf16 param replica
+    (``param_bytes``) plus ``opt_bytes_per_param / n`` of shards.
+    The measured companion is ``bench_bert_o1_zero`` (hbm_peak A/B +
+    exact placed-array shard bytes); trajectory agreement is gated by
+    ``test_loss_trajectory``'s DP-vs-ZeRO-2 band leg.
+    """
+    n = int(shards)
+    frac = (n - 1) / n
+    gbytes = {"fp32": 4, "bf16": 2, "fp16": 2, "int8": 1}[
+        str(reduce_dtype)]
+    scales = scale_stages * 4 * n if gbytes == 1 else 0
+    rs = frac * n_params * gbytes + scales
+    if stage == 1:
+        # full all-reduce (both legs) instead of the single RS leg
+        rs = 2 * frac * n_params * gbytes + scales
+    ag = frac * n_params * param_bytes
+    dp_wire = 2 * frac * n_params * 4
+    state_dp = opt_bytes_per_param * n_params
+    state_zero = param_bytes * n_params + opt_bytes_per_param * n_params / n
+    return {
+        "shards": n,
+        "stage": int(stage),
+        "reduce_dtype": str(reduce_dtype),
+        "grad_elements": int(n_params),
+        "wire_bytes_reduce_scatter": int(rs),
+        "wire_bytes_param_all_gather": int(ag),
+        "wire_bytes_per_step_zero": int(rs + ag),
+        "wire_bytes_per_step_dp_fp32_allreduce": int(dp_wire),
+        "wire_reduction_vs_dp": round(dp_wire / (rs + ag), 2),
+        "model_state_bytes_per_chip_dp": int(state_dp),
+        "model_state_bytes_per_chip_zero": int(state_zero),
+        "state_bytes_saved_per_chip": int(state_dp - state_zero),
+        "state_savings_frac": round(1 - state_zero / state_dp, 3),
+    }
+
+
+def bench_bert_o1_zero():
+    """Measured ISSUE-11 row: the BERT recipe under 8-way DP at O2,
+    A/B'ing replicated optimizer state against ZeRO-2
+    (``parallel.distributed_optim``: reduce-scatter grads →
+    shard-local FusedAdam on fp32 master shards → bf16 param
+    all-gather).  Three rows:
+
+    - ``dp`` — the baseline: fp32 masters + both moments replicated,
+      fp32 grad all-reduce.
+    - ``zero2`` — same global batch: the hbm_peak / state-bytes drop
+      at unchanged math (final-loss agreement emitted; the band gate
+      is ``test_loss_trajectory``'s DP-vs-ZeRO-2 leg).
+    - ``zero2_grown`` — the reclaimed-capacity-becomes-throughput
+      play: the per-chip batch grown until the ZeRO step's modeled
+      HBM fills the DP baseline's budget, samples/sec at the larger
+      batch.  (CPU-mesh proxy: the HBM numbers are XLA
+      memory-analysis bytes of the compiled step — exact and
+      deterministic; the wall ratio prices CPU compute, not HBM
+      bandwidth — on chip the larger batch's win follows the
+      roofline as usual.)
+
+    Env: BENCH_BERT_ZERO_LAYERS (2), BENCH_BATCH (16 global),
+    BENCH_SEQ (128), BENCH_ZERO_STEPS (8), BENCH_ZERO_GROWN_BATCH
+    (0 = derive from the reclaimed bytes)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import amp
+    from apex_tpu import parallel as apx_parallel
+    from apex_tpu.models import BertConfig, BertModel, bert_mlm_loss_fn
+    from apex_tpu.optim import fused_adam
+    from apex_tpu.parallel import ZeroConfig, zero_state_specs
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        _emit({"metric": "bert_o1_zero", "value": None,
+               "skipped": f"needs >= 2 devices, have {n_dev}"})
+        return
+    layers = int(os.environ.get("BENCH_BERT_ZERO_LAYERS", "2"))
+    b = int(os.environ.get("BENCH_BATCH", "16"))
+    b -= b % n_dev
+    b = max(b, n_dev)
+    cfg = BertConfig.bert_large(remat=True, dtype=None,
+                                scan_layers=False, num_layers=layers)
+    model = BertModel(cfg)
+    s = int(os.environ.get("BENCH_SEQ", str(min(cfg.max_seq_len, 128))))
+    p = min(max(8, int(0.15 * s / 8 + 0.5) * 8), s)
+    steps = int(os.environ.get("BENCH_ZERO_STEPS", "8"))
+
+    def batch_of(nb):
+        rng = jax.random.PRNGKey(0)
+        ids = jax.random.randint(rng, (nb, s), 0, cfg.vocab_size)
+        positions = jnp.argsort(jax.random.uniform(rng, (nb, s)),
+                                axis=-1)[:, :p]
+        return ids, positions, jnp.take_along_axis(ids, positions,
+                                                   axis=1)
+
+    init = model.init(jax.random.PRNGKey(0), batch_of(2)[0])
+    n_params = sum(x.size for x in jax.tree.leaves(init))
+    tx = fused_adam(1e-4)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:n_dev]),
+                             ("data",))
+
+    def loss_grads(state, ids, positions, mlm_labels):
+        def loss_fn(pr):
+            cp = state.policy.cast_to_compute(pr)
+            logits, _ = state.apply_fn(
+                cp, ids, mlm_positions=positions, deterministic=True)
+            loss = bert_mlm_loss_fn(logits.astype(jnp.float32),
+                                    mlm_labels)
+            return state.scale_loss(loss), loss
+
+        return jax.grad(loss_fn, has_aux=True)(state.params)
+
+    def measure(step, state, batch, nb, extra):
+        compiled = bench._aot_compile(step, state, *batch)
+        timed = compiled if compiled is not None else step
+        state, loss, finite = timed(state, *batch)
+        bench._sync(loss)                  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss, finite = timed(state, *batch)
+        bench._sync(loss)
+        dt = (time.perf_counter() - t0) / steps
+        mem = {}
+        if compiled is not None:
+            try:
+                ana = compiled.memory_analysis()
+                mem = {
+                    "argument": getattr(ana, "argument_size_in_bytes",
+                                        None),
+                    "output": getattr(ana, "output_size_in_bytes",
+                                      None),
+                    "temp": getattr(ana, "temp_size_in_bytes", None),
+                }
+            except Exception:
+                mem = {}
+        row = {
+            "global_batch": nb,
+            "samples_per_sec": round(nb / dt, 2),
+            "step_ms": round(dt * 1e3, 2),
+            "final_loss": round(float(loss), 5),
+            "loss_finite": bool(finite),
+            "hbm_analysis_bytes": mem,
+            "hbm_peak_bytes": bench._analysis_estimate(mem) if mem
+            else None,
+        }
+        row.update(extra)
+        return row
+
+    def run_dp(nb):
+        state = amp.initialize(model.apply,
+                               jax.tree.map(jnp.copy, init), tx,
+                               opt_level="O2",
+                               half_dtype=jnp.bfloat16)
+
+        def dp_step(state, ids, positions, mlm_labels):
+            grads, loss = loss_grads(state, ids, positions, mlm_labels)
+            grads = apx_parallel.all_reduce_mean_grads(grads, "data")
+            new_state, finite = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data"), finite
+
+        step = jax.jit(jax.shard_map(
+            dp_step, mesh=mesh,
+            in_specs=(P(), P("data"), P("data"), P("data")),
+            out_specs=(P(), P(), P()), check_vma=False),
+            donate_argnums=(0,))
+        # replicated resident state: fp32 masters + both moments on
+        # every chip
+        state_bytes = sum(l.size * l.dtype.itemsize
+                          for l in jax.tree.leaves(state.opt_state)) \
+            + sum(l.size * l.dtype.itemsize
+                  for l in jax.tree.leaves(state.params))
+        return measure(step, state, batch_of(nb), nb,
+                       {"layout": "replicated",
+                        "state_bytes_per_chip": int(state_bytes)})
+
+    def run_zero(nb):
+        state = amp.initialize(model.apply,
+                               jax.tree.map(jnp.copy, init), tx,
+                               opt_level="O2", half_dtype=jnp.bfloat16,
+                               zero=ZeroConfig(axis="data", stage=2,
+                                               axis_size=n_dev))
+        specs = zero_state_specs(state)
+
+        def z_step(state, ids, positions, mlm_labels):
+            grads, loss = loss_grads(state, ids, positions, mlm_labels)
+            new_state, finite = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data"), finite
+
+        step = jax.jit(jax.shard_map(
+            z_step, mesh=mesh,
+            in_specs=(specs, P("data"), P("data"), P("data")),
+            out_specs=(specs, P(), P()), check_vma=False),
+            donate_argnums=(0,))
+        # sharded resident state: 1/n of masters+moments + the bf16
+        # param replica
+        state_bytes = sum(
+            -(-l.size // n_dev) * l.dtype.itemsize
+            for l in jax.tree.leaves(state.opt_state)) \
+            + sum(l.size * l.dtype.itemsize
+                  for l in jax.tree.leaves(state.params))
+        return measure(step, state, batch_of(nb), nb,
+                       {"layout": "zero2_sharded",
+                        "state_bytes_per_chip": int(state_bytes)})
+
+    dp = run_dp(b)
+    zero = run_zero(b)
+
+    # grow the per-chip batch into the reclaimed HBM: activation bytes
+    # scale ~linearly with batch (temp dominates), so the headroom in
+    # samples is reclaimed / (temp / batch)
+    grown = int(os.environ.get("BENCH_ZERO_GROWN_BATCH", "0"))
+    reclaimed = (dp["hbm_peak_bytes"] or 0) - (zero["hbm_peak_bytes"]
+                                               or 0)
+    if not grown:
+        temp = (zero["hbm_analysis_bytes"] or {}).get("temp") or 0
+        per_sample = max(temp // max(b, 1), 1)
+        grown = b + max(int(reclaimed // per_sample), 0)
+        grown = min(grown, 4 * b)
+        grown -= grown % n_dev
+        grown = max(grown, b)
+    zero_grown = run_zero(grown)
+    fits = (zero_grown["hbm_peak_bytes"] or 0) <= \
+        (dp["hbm_peak_bytes"] or 0)
+
+    _emit({
+        "metric": "bert_o2_zero2_samples_per_sec",
+        "value": zero_grown["samples_per_sec"],
+        "unit": "samples/sec (CPU-mesh proxy)",
+        "replicas": n_dev, "seq": s, "num_layers": layers,
+        "num_params": int(n_params),
+        "rows": {"dp": dp, "zero2": zero, "zero2_grown": zero_grown},
+        "hbm_peak_drop_bytes": int(reclaimed),
+        "hbm_peak_drop_frac": round(
+            reclaimed / dp["hbm_peak_bytes"], 3)
+        if dp["hbm_peak_bytes"] else None,
+        "state_bytes_saved_per_chip": (
+            dp["state_bytes_per_chip"] - zero["state_bytes_per_chip"]),
+        "grown_batch": grown,
+        "grown_batch_fits_dp_hbm_budget": bool(fits),
+        "sps_grown_vs_dp": round(
+            zero_grown["samples_per_sec"]
+            / max(dp["samples_per_sec"], 1e-9), 3),
+        "final_loss_delta_equal_batch": round(
+            abs(zero["final_loss"] - dp["final_loss"]), 5),
+        "zero_bytes_on_wire": _zero_bytes_on_wire(n_params, n_dev),
+        "note": ("ISSUE-11 row: optimizer bytes MOVE (sharded "
+                 "residency, exact placed-array accounting above) and "
+                 "the hbm numbers are XLA memory-analysis bytes of "
+                 "the compiled steps; trajectory agreement is gated "
+                 "by test_loss_trajectory's DP-vs-ZeRO-2 band leg; "
+                 "the CPU wall ratio prices compute, not HBM — "
+                 "on-chip the grown batch converts the reclaimed "
+                 "capacity per the roofline"),
     })
 
 
@@ -3117,6 +3407,7 @@ LEGS = {
     "resnet50_syncbn": bench_resnet50_syncbn,
     "bert_o1": bench_bert_o1,
     "bert_o1_ddp": bench_bert_o1_ddp,
+    "bert_o1_zero": bench_bert_o1_zero,
     "gpt2_1p3b": bench_gpt2_1p3b,
     "gpt2_tp8_full_step": bench_gpt2_tp8_full_step,
     "gpt2_3d_full_step": bench_gpt2_3d_full_step,

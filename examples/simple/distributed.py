@@ -6,12 +6,23 @@ The reference launches one process per GPU and wraps the model in
 backward.  TPU-native: one process, a ``Mesh`` over all devices, batch
 sharded on the ``data`` axis — jit inserts the gradient ``psum``.
 
+``--zero {0,1,2}`` (ISSUE 11) swaps the replicated optimizer for the
+ZeRO-sharded one (``apex_tpu.parallel.distributed_optim``): fp32
+masters and Adam/SGD moments shard over the ``data`` axis instead of
+being hand-replicated on every device, gradients reduce-scatter
+(stage 2; stage 1 all-reduces then slices), and the updated params
+all-gather in the compute dtype.  ``--zero-int8`` additionally puts
+the grad sync on the int8 quantized wire.  The state placement comes
+from ``zero_shardings`` — which is also the checkpoint-restore
+target, so ``--ckpt-dir`` resume lands the shards exactly where a
+fresh run puts them.
+
 The loop runs under ``apex_tpu.resilience.ResilientLoop`` — with
 ``--ckpt-dir`` it survives kill -TERM (final checkpoint + clean exit)
 and auto-resumes on relaunch; without, the wrapper is a near-free
 pass-through (the ``resilience_overhead`` bench leg quantifies it).
 
-  python examples/simple/distributed.py [--ckpt-dir /tmp/ddp_ckpts]
+  python examples/simple/distributed.py [--zero 2] [--ckpt-dir /tmp/d]
 """
 
 from __future__ import annotations
@@ -27,6 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu import amp, initialize_mesh
 from apex_tpu.optim import fused_sgd
+from apex_tpu.parallel import ZeroConfig, zero_shardings, zero_state_specs
 from apex_tpu.resilience import ResilientCheckpointer, ResilientLoop
 
 
@@ -42,7 +54,16 @@ def main():
     ap.add_argument("--ckpt-dir", default=None,
                     help="rolling checkpoints + auto-resume here")
     ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--zero", type=int, default=0, choices=(0, 1, 2),
+                    help="ZeRO stage: 0 = replicated optimizer state, "
+                         "1 = sharded state + all-reduce grads, "
+                         "2 = sharded state + reduce-scatter grads")
+    ap.add_argument("--zero-int8", action="store_true",
+                    help="int8 quantized wire for the ZeRO grad sync")
     args = ap.parse_args()
+    if args.zero_int8 and not args.zero:
+        ap.error("--zero-int8 needs --zero 1 or 2 (the int8 wire is "
+                 "the ZeRO grad sync's dtype)")
     # multi-host: pick up MASTER_ADDR/RANK/WORLD_SIZE (the reference
     # launcher's env contract) if set; single-host no-op
     from apex_tpu.parallel import init_distributed
@@ -53,31 +74,70 @@ def main():
 
     net = Net()
     params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 16)))["params"]
+    zero = None
+    if args.zero:
+        zero = ZeroConfig(
+            axis="data", stage=args.zero,
+            reduce_dtype="int8" if args.zero_int8 else None,
+            axis_size=ndev)
     state = amp.initialize(
         lambda p, x: net.apply({"params": p}, x), params,
-        fused_sgd(0.05), opt_level="O0")
+        fused_sgd(0.05, momentum=0.9), opt_level="O0", zero=zero)
 
     rng = np.random.default_rng(0)
     X = jnp.asarray(rng.normal(size=(64 * ndev, 16)), jnp.float32)
     Y = jnp.sum(X[:, :4], axis=1, keepdims=True)
     sharding = NamedSharding(mesh, P("data"))
     X, Y = jax.device_put(X, sharding), jax.device_put(Y, sharding)
-    # committed-replicated carry so a checkpoint-restored state (which
-    # lands on its target's placement) matches the fresh-run placement
-    state = jax.device_put(state, NamedSharding(mesh, P()))
 
-    # donate the threaded state; X/Y are reused across the whole loop
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def train_step(state, x, y):
-        def loss_fn(p):
-            # loss reduction anchored in fp32 (the convention every
-            # model loss here follows): under a half-dtype net the
-            # MSE mean would otherwise accumulate in bf16
-            pred = state.apply_fn(p, x).astype(jnp.float32)
-            return jnp.mean((pred - y) ** 2)
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        new_state, _ = state.apply_gradients(grads=grads)
-        return new_state, loss
+    if zero is not None:
+        # sharded masters + optimizer state, replicated params — the
+        # committed placement doubles as the checkpoint-restore target
+        state = jax.device_put(state, zero_shardings(state, mesh=mesh))
+        shard_bytes = sum(
+            int(np.prod(l.sharding.shard_shape(l.shape))) * l.dtype.itemsize
+            for l in jax.tree.leaves(state.opt_state))
+        print(f"zero: stage {args.zero} over {ndev}-way 'data' axis, "
+              f"reduce_dtype="
+              f"{'int8' if args.zero_int8 else 'fp32'}, "
+              f"optimizer-state shard {shard_bytes} B/device "
+              f"(~1/{ndev} of replicated)")
+        specs = zero_state_specs(state)
+
+        # the step runs fully-manual inside shard_map: per-replica
+        # grads go straight to apply_gradients, which owns the ZeRO
+        # reduce-scatter / shard-local update / param all-gather
+        def zero_step(state, x, y):
+            def loss_fn(p):
+                pred = state.apply_fn(p, x).astype(jnp.float32)
+                return jnp.mean((pred - y) ** 2)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            new_state, _ = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data")
+
+        train_step = jax.jit(jax.shard_map(
+            zero_step, mesh=mesh,
+            in_specs=(specs, P("data"), P("data")),
+            out_specs=(specs, P()), check_vma=False),
+            donate_argnums=(0,))
+    else:
+        # committed-replicated carry so a checkpoint-restored state
+        # (which lands on its target's placement) matches the
+        # fresh-run placement
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+
+        # donate the threaded state; X/Y are reused across the loop
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def train_step(state, x, y):
+            def loss_fn(p):
+                # loss reduction anchored in fp32 (the convention every
+                # model loss here follows): under a half-dtype net the
+                # MSE mean would otherwise accumulate in bf16
+                pred = state.apply_fn(p, x).astype(jnp.float32)
+                return jnp.mean((pred - y) ** 2)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            new_state, _ = state.apply_gradients(grads=grads)
+            return new_state, loss
 
     def loop_step(state, batch):
         state, loss = train_step(state, *batch)

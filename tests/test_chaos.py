@@ -23,6 +23,12 @@ Two acceptance soaks for the resilience layer (docs/resilience.md):
 - **quantized paged soak** (ISSUE 8): the sharing+spec paged soak
   with ``kv_dtype="int8"`` — zero lost/hung, ``blocks_in_use == 0``
   (per-page scales freed with their pages), budgets exactly 5 × 1.
+- **ZeRO-sharded kill-and-resume** (ISSUE 11): the training soak with
+  optimizer state ZeRO-2-sharded over the 8-device mesh — checkpoint
+  mid-run, kill, restore onto the ``zero_shardings`` placement,
+  spliced trajectory allclose to uninterrupted; plus the
+  ``bert_o1_zero`` bench leg's CPU-tiny smoke (measured hbm drop,
+  grown-batch row, loss agreement).
 
 The serving and fleet soaks also run under the **strict runtime lock
 sanitizer** (``apex_tpu.utils.lockcheck``, ISSUE 9): every lock in the
@@ -215,6 +221,207 @@ class TestKillAndResumeTrajectory:
         jax.effects_barrier()
         numcheck.assert_clean()
         assert numcheck.summary()["grad_stat_steps"] > 0
+
+
+class TestZeroKillAndResumeTrajectory:
+    """ISSUE-11 chaos arm: the kill-and-resume soak with the optimizer
+    state ZeRO-2-SHARDED over an 8-device mesh.  Checkpoint mid-run,
+    kill via an injected preemption, restore with the
+    ``zero_shardings`` placement (the checkpoint target is the placed
+    state, so orbax lands the master/moment shards back on their mesh
+    rows), and the spliced trajectory must match the uninterrupted run
+    — sharding the state must not change WHAT is persisted, only
+    where it lives.  Runs under the strict numerics sanitizer: fp32
+    master shards verified at runtime across kill and resume.
+    """
+
+    STEPS = 40
+    B, S = 8, 16            # batch divisible by the 8-way mesh
+    CKPT_EVERY = 8
+
+    @pytest.fixture(autouse=True)
+    def _numcheck_strict(self):
+        numcheck.reset()
+        numcheck.instrument(strict=True)
+        yield
+        numcheck.uninstrument()
+        numcheck.reset()
+
+    def _make(self):
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel import (ZeroConfig, zero_shardings,
+                                       zero_state_specs)
+
+        model, init_params = standalone_gpt(seed=0, max_seq_len=self.S)
+        vocab = model.cfg.vocab_size
+        ids = jax.random.randint(
+            jax.random.PRNGKey(1234), (4, self.B, self.S + 1), 0,
+            vocab, jnp.int32)
+        # raw mesh, fully-manual step (test_loss_trajectory precedent)
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]),
+                                 ("data",))
+        tx = fused_adam(3e-4)   # ONE transform: shared static treedef
+
+        def make_state():
+            state = amp.initialize(
+                model.apply, {"params": init_params}, tx,
+                opt_level="O0",
+                zero=ZeroConfig(axis="data", stage=2, axis_size=8))
+            # committed sharded placement — doubles as the
+            # checkpoint-restore target
+            return jax.device_put(state,
+                                  zero_shardings(state, mesh=mesh))
+
+        specs = zero_state_specs(make_state())
+
+        def z_step(state, chunk):
+            inputs, labels = chunk[:, :-1], chunk[:, 1:]
+
+            def loss_fn(p):
+                logits = state.apply_fn(p, inputs)
+                return gpt_loss_fn(logits.astype(jnp.float32), labels)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            new_state, _finite = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data")
+
+        step = jax.jit(jax.shard_map(
+            z_step, mesh=mesh,
+            in_specs=(specs, P("data")), out_specs=(specs, P()),
+            check_vma=False))
+
+        def loop_step(state, batch):
+            state, loss = step(state, batch)
+            return state, {"loss": loss}
+
+        def data_fn(i):
+            return ids[i % 4]
+
+        return make_state, step, loop_step, data_fn
+
+    def _rows(self, writer):
+        return {s: r["loss"] for s, r in writer.history}
+
+    def test_sharded_preempt_resume_matches_uninterrupted(
+            self, tmp_path):
+        from jax.sharding import PartitionSpec as P
+
+        make_state, step, loop_step, data_fn = self._make()
+
+        # ------------------------- the uninterrupted reference run
+        state = make_state()
+        ref = []
+        for i in range(self.STEPS):
+            state, loss = step(state, data_fn(i))
+            ref.append(float(loss))
+        assert np.all(np.isfinite(ref))
+        assert ref[-1] < ref[0]
+
+        # ------------------- run 1: killed by injected preemption
+        ckpt_dir = str(tmp_path / "ckpts")
+        kill_at = 17
+        writer1 = MetricsWriter(sink=lambda s, m: None)
+        loop1 = ResilientLoop(
+            loop_step,
+            checkpointer=ResilientCheckpointer(ckpt_dir, keep=3),
+            checkpoint_every=self.CKPT_EVERY,
+            scalars_of=lambda aux: {"loss": aux["loss"]},
+            metrics=writer1)
+        plan = FaultPlan([FaultSpec(site="train.step", kind="preempt",
+                                    step=kill_at, times=1)])
+        with active(plan):
+            _carry, report1 = loop1.run(make_state(), data_fn,
+                                        self.STEPS)
+        assert report1.preempted
+        assert report1.final_step == kill_at
+
+        # ------------------- run 2: auto-resume onto the SHARDED
+        # placement (the target is the zero_shardings-placed state)
+        writer2 = MetricsWriter(sink=lambda s, m: None)
+        loop2 = ResilientLoop(
+            loop_step,
+            checkpointer=ResilientCheckpointer(ckpt_dir, keep=3),
+            checkpoint_every=self.CKPT_EVERY,
+            scalars_of=lambda aux: {"loss": aux["loss"]},
+            metrics=writer2)
+        carry2, report2 = loop2.run(make_state(), data_fn, self.STEPS)
+        assert report2.resumed_from == kill_at
+        assert report2.final_step == self.STEPS
+        assert not report2.preempted
+
+        # master shards came back ON their mesh rows: 1/8-sized
+        # addressable shards with the zero spec
+        for leaf in jax.tree.leaves(carry2.opt_state.master):
+            # (trailing-None spec normalization differs across paths)
+            assert tuple(leaf.sharding.spec)[:1] == ("data",)
+            assert leaf.sharding.shard_shape(leaf.shape)[0] * 8 \
+                == leaf.shape[0]
+            assert leaf.dtype == jnp.float32
+
+        # ------------------------- the spliced trajectory matches
+        rows1, rows2 = self._rows(writer1), self._rows(writer2)
+        spliced = [rows1[i] if i <= report2.resumed_from else rows2[i]
+                   for i in range(1, self.STEPS + 1)]
+        np.testing.assert_allclose(
+            spliced, ref, rtol=0, atol=1e-5,
+            err_msg="ZeRO-sharded resume diverged from uninterrupted")
+
+        # ------------------- strict numerics oracle: clean, and the
+        # shard-local updates consumed only fp32 masters
+        jax.effects_barrier()
+        numcheck.assert_clean()
+        hist = numcheck.site_histograms()
+        assert set(hist["apply_gradients.master_shards"]) \
+            == {"float32"}
+
+
+class TestZeroBenchSmoke:
+    """ISSUE-11 CI bench smoke: the ``bert_o1_zero`` leg at a CPU-tiny
+    preset — the emission must carry a measured hbm_peak/state-bytes
+    drop for ZeRO-2 vs the replicated-DP baseline, a grown-batch row
+    that fits the DP HBM budget, and final-loss agreement at equal
+    batch.  (The full-size leg rides ``bench_configs.py bert_o1``
+    on-chip; this pins the protocol and the emission schema.)"""
+
+    def test_zero_leg_emits_hbm_drop(self):
+        import json
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device"
+                              "_count=8").strip()
+        env.update({"BENCH_BERT_ZERO_LAYERS": "1", "BENCH_BATCH": "8",
+                    "BENCH_SEQ": "32", "BENCH_ZERO_STEPS": "2"})
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "bench_configs.py"), "bert_o1_zero"],
+            env=env, capture_output=True, text=True, timeout=900)
+        assert r.returncode == 0, r.stderr[-2000:]
+        rows = [json.loads(l) for l in r.stdout.splitlines()
+                if l.startswith("{")]
+        assert rows, r.stdout[-2000:]
+        out = rows[-1]
+        assert out["metric"] == "bert_o2_zero2_samples_per_sec"
+        # the tentpole acceptance: measured hbm drop, sharded-state
+        # residency drop, grown batch inside the DP budget, loss
+        # agreement at equal batch
+        assert out["hbm_peak_drop_bytes"] > 0, out
+        assert out["state_bytes_saved_per_chip"] > 0, out
+        assert out["rows"]["zero2"]["state_bytes_per_chip"] \
+            < out["rows"]["dp"]["state_bytes_per_chip"]
+        assert out["grown_batch"] >= out["rows"]["dp"]["global_batch"]
+        assert out["grown_batch_fits_dp_hbm_budget"], out
+        assert out["final_loss_delta_equal_batch"] < 0.05, out
+        model = out["zero_bytes_on_wire"]
+        assert model["state_bytes_saved_per_chip"] > 0
+        assert model["wire_reduction_vs_dp"] > 1.0
 
 
 class TestMixedPrecisionBenchSmoke:

@@ -126,6 +126,54 @@ class TestTransformerTPExample:
         assert "multiple of the microbatch" in (r.stderr + r.stdout)
 
 
+class TestDistributedExample:
+    def test_zero2_trains_sharded(self):
+        # ISSUE-11 satellite: the --zero path stops hand-replicating
+        # optimizer state — sharded masters/moments over the 8-device
+        # 'data' axis, reduce-scatter grad sync, ResilientLoop intact
+        r = _run_example("examples/simple/distributed.py",
+                         ["--zero", "2", "--steps", "30"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "zero: stage 2 over 8-way 'data' axis" in r.stdout, \
+            r.stdout[-2000:]
+        # the printed state shard is a genuine 1/n slice
+        assert "B/device (~1/8 of replicated)" in r.stdout
+        losses = re.findall(r"loss (\d+\.\d+)", r.stdout)
+        assert losses, r.stdout[-2000:]
+        assert all(np.isfinite(float(l)) for l in losses)
+        assert float(losses[-1]) < float(losses[0])
+
+    @pytest.mark.slow
+    def test_zero1_int8_wire_trains(self):
+        # [slow: a second subprocess run of the same example; the
+        # stage-1 and int8-wire semantics are tier-1-covered by
+        # test_zero.py]
+        r = _run_example("examples/simple/distributed.py",
+                         ["--zero", "1", "--zero-int8",
+                          "--steps", "30"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "zero: stage 1" in r.stdout and "int8" in r.stdout
+        losses = re.findall(r"loss (\d+\.\d+)", r.stdout)
+        assert losses and float(losses[-1]) < float(losses[0])
+
+    @pytest.mark.slow
+    def test_zero2_ckpt_resume(self, tmp_path):
+        # [slow: two subprocess runs — kill-free resume of the SHARDED
+        # state through the zero_shardings restore target; the
+        # placement semantics are tier-1-covered by test_zero.py]
+        d = str(tmp_path / "ckpts")
+        r1 = _run_example("examples/simple/distributed.py",
+                          ["--zero", "2", "--steps", "25",
+                           "--ckpt-dir", d])
+        assert r1.returncode == 0, r1.stderr[-2000:]
+        r2 = _run_example("examples/simple/distributed.py",
+                          ["--zero", "2", "--steps", "40",
+                           "--ckpt-dir", d])
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        m = re.search(r"resumed_from (\d+)", r2.stdout)
+        assert m and int(m.group(1)) >= 20, r2.stdout[-2000:]
+
+
 class TestServingDemoExample:
     def test_mixed_traffic_serves(self):
         r = _run_example("examples/serving_demo.py",

@@ -1446,6 +1446,50 @@ class TestMasterWeightViolation:
         assert names(found) == [self.RULE]
         assert "masters stay fp32" in found[0].message
 
+    # -- ISSUE-11 fixtures: the rule sees ZeRO-SHARDED master shards —
+    # the shard-local update is the same marked call shape, so a half
+    # shard tree is flagged and the fp32 shard tree is clean; ZeRO
+    # cannot silently drop the fp32-master discipline.
+
+    ZERO_SHARDED = """
+        import jax
+        import jax.numpy as jnp
+
+        # graftlint: precision(master-fp32)
+        def shard_update(grad_shards, master_shards):
+            return master_shards
+
+        def zero_step(state, grad_shards):
+            {prep}
+            return shard_update(grad_shards, shards)
+    """
+
+    def test_flagged_zero_update_on_half_master_shards(self):
+        found = lint(self.ZERO_SHARDED.format(
+            prep="shards = state.opt_state.master"
+                 ".astype(jnp.bfloat16)"), self.RULE)
+        assert names(found) == [self.RULE]
+        assert "master-fp32" in found[0].message
+
+    def test_clean_zero_update_on_fp32_master_shards(self):
+        assert lint(self.ZERO_SHARDED.format(
+            prep="shards = state.opt_state.master"
+                 ".astype(jnp.float32)"), self.RULE) == []
+
+    def test_flagged_zero_shard_downcast_inside_marked_body(self):
+        # the shard-shaped twin of the body contract: a marked
+        # shard-local update must not downcast its own master shards
+        found = lint("""
+            import jax.numpy as jnp
+
+            # graftlint: precision(master-fp32)
+            def shard_update(grad_shards, master_shards):
+                m16 = master_shards.astype(jnp.float16)
+                return m16 + grad_shards
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "masters stay fp32" in found[0].message
+
 
 class TestUnscaledGradUse:
     """P3: grads carry the loss scale until unscale/apply_gradients —

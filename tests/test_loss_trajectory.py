@@ -11,6 +11,15 @@ run comparing trajectories that must agree within a band.
   allreduce_dtype="int8")``) against the exact fp32 all-reduce — the
   ~amax/127-per-stage quantization noise must not bend the
   optimization trajectory outside the same band.
+- **DP vs ZeRO-2** (ISSUE-11 satellite): the same 8-way O2 recipe
+  with replicated optimizer state against the ZeRO-2 sharded one
+  (``parallel.distributed_optim``: reduce-scatter grads, shard-local
+  FusedAdam on fp32 master shards, bf16 param all-gather) — moving
+  where the optimizer bytes *live* must not move the trajectory.  The
+  ZeRO arm runs under the strict runtime numerics sanitizer
+  (``APEX_TPU_NUMCHECK=strict`` semantics): zero violations, and the
+  ``apply_gradients.master_shards`` histogram proves the shard-local
+  update consumed only fp32 masters.
 
 Both use the same band machinery: same data order, same init,
 FusedAdam, 300 steps; the trajectories must (a) both decrease
@@ -192,3 +201,109 @@ def test_exact_vs_int8_allreduce_loss_trajectory_agreement():
 
     _assert_trajectories_agree(run(None), run("int8"),
                                names=("fp32", "int8"))
+
+
+@pytest.mark.slow
+def test_dp_vs_zero2_loss_trajectory_agreement():
+    """ISSUE-11 acceptance leg: exact-DP vs ZeRO-2 on the
+    testing-commons GPT under O2/bf16 — same band machinery as the
+    legs above; the only difference is where the optimizer state
+    lives and how the grads sync (all-reduce of full grads vs
+    reduce-scatter into fp32 master shards + bf16 param all-gather).
+    The ZeRO arm runs under the strict numerics sanitizer."""
+    from apex_tpu import parallel as apx_parallel
+    from apex_tpu.parallel import ZeroConfig, zero_state_specs
+    from jax.sharding import PartitionSpec as P
+
+    steps = 300
+    b, s = 16, 32                    # 2 rows per shard on 8 devices
+
+    model, init_params = standalone_gpt(seed=0, max_seq_len=s)
+    vocab = model.cfg.vocab_size
+    n_pool = 4
+    ids = jax.random.randint(jax.random.PRNGKey(1234),
+                             (n_pool, b, s + 1), 0, vocab, jnp.int32)
+    # raw mesh, NOT registered with core.mesh (see the int8 leg above)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    def losses_of(step, state):
+        out = []
+        for i in range(steps):
+            state, loss = step(state, ids[i % n_pool])
+            out.append(float(loss))
+        return np.asarray(out)
+
+    def run_dp():
+        state = amp.initialize(
+            model.apply, {"params": init_params}, fused_adam(3e-4),
+            opt_level="O2", half_dtype=jnp.bfloat16)
+
+        def dp_step(state, chunk):
+            inputs, labels = chunk[:, :-1], chunk[:, 1:]
+
+            def loss_fn(p):
+                cp = state.policy.cast_to_compute(p)
+                logits = state.apply_fn(cp, inputs)
+                loss = gpt_loss_fn(logits.astype(jnp.float32), labels)
+                return state.scale_loss(loss), loss
+
+            grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
+            grads = apx_parallel.all_reduce_mean_grads(grads, "data")
+            new_state, _ = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data")
+
+        step = jax.jit(jax.shard_map(
+            dp_step, mesh=mesh,
+            in_specs=(P(), P("data")), out_specs=(P(), P()),
+            check_vma=False))
+        return losses_of(step, state)
+
+    def run_zero2():
+        state = amp.initialize(
+            model.apply, {"params": init_params}, fused_adam(3e-4),
+            opt_level="O2", half_dtype=jnp.bfloat16,
+            zero=ZeroConfig(axis="data", stage=2, axis_size=8))
+        specs = zero_state_specs(state)
+
+        def z_step(state, chunk):
+            inputs, labels = chunk[:, :-1], chunk[:, 1:]
+
+            def loss_fn(p):
+                cp = state.policy.cast_to_compute(p)
+                logits = state.apply_fn(cp, inputs)
+                loss = gpt_loss_fn(logits.astype(jnp.float32), labels)
+                return state.scale_loss(loss), loss
+
+            grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
+            # per-replica grads: apply_gradients owns the sync
+            new_state, _ = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data")
+
+        step = jax.jit(jax.shard_map(
+            z_step, mesh=mesh,
+            in_specs=(specs, P("data")), out_specs=(specs, P()),
+            check_vma=False))
+        return losses_of(step, state)
+
+    l_dp = run_dp()
+    numcheck.reset()
+    numcheck.instrument(strict=True)
+    try:
+        l_zero = run_zero2()
+        jax.effects_barrier()
+        numcheck.assert_clean()
+        hist = numcheck.site_histograms()
+        # fp32 master shards verified at runtime
+        assert set(hist["apply_gradients.master_shards"]) == \
+            {"float32"}, hist
+        stats = numcheck.summary()
+        assert stats["grad_stat_steps"] > 0
+        context = (f"numcheck[zero2]: underflow_frac="
+                   f"{stats['grad_underflow_frac']:.4f} "
+                   f"violations={stats['violations']}")
+    finally:
+        numcheck.uninstrument()
+        numcheck.reset()
+
+    print(context)      # lands in the failure report via pytest -rA
+    _assert_trajectories_agree(l_dp, l_zero, names=("DP", "ZeRO-2"))

@@ -350,6 +350,14 @@ class TestFusedLAMB:
         tx = ao.fused_lamb(1e-2, weight_decay=0.01, trust_clip=True)
         _run_jax(tx, params, grads)  # smoke: compiles & runs
 
+    def test_empty_param_tree(self):
+        # regression for the batched trust-ratio norms (ISSUE 11):
+        # an empty tree must not hit jnp.stack([]) at trace time
+        tx = ao.fused_lamb(1e-2, weight_decay=0.01)
+        state = tx.init({})
+        updates, _ = tx.update({}, state, {})
+        assert updates == {}
+
 
 class TestFusedNovoGrad:
     def test_first_step_v_init(self, rng):

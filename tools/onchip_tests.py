@@ -60,6 +60,11 @@ SUBSET = [
     # unit tier — on chip the fp16 downcast-overflow and underflow
     # paths run against real MXU/VPU rounding, not the CPU emulation
     "tests/test_numcheck.py",
+    # ZeRO-1/2 (ISSUE 11): the reduce-scatter/all-gather choreography,
+    # the int8 wire leg and the sharded-checkpoint placement must run
+    # against REAL ICI collectives and per-device HBM — the virtual
+    # CPU mesh proves the math, not the placement or the wire
+    "tests/test_zero.py",
     "tests/test_chaos.py",
 ]
 
