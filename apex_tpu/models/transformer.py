@@ -33,6 +33,7 @@ from apex_tpu.ops.paged_attention import (
     kv_quant_spec,
     paged_attention,
     quantize_kv,
+    tp_head_shards,
 )
 from apex_tpu.ops.mlp import resolve_activation
 from apex_tpu.ops.rope import fused_rope, rope_cos_sin
@@ -139,6 +140,21 @@ class TransformerConfig:
     # them and the engine's accounting never changes.  Paged-only: the
     # dense slab and the training path always store the compute dtype.
     kv_dtype: Optional[str] = None
+    # tensor-parallel paged serving (ISSUE 13): shard the paged pool on
+    # its kv_heads axis over `kv_shard_axis` of `kv_mesh` so ONE
+    # serving replica spans the mesh — each chip stores (and attends
+    # over) kv_heads / tp heads' pages, with the per-(kv_head, page)
+    # quant scales sharding on the same leading axis, while block
+    # tables / cursors / chunk_lens stay REPLICATED (the engine's host
+    # allocator, refcounts, CoW and trie never learn about the mesh).
+    # Attention routes through the shard_map path of
+    # ops.paged_attention; the matmuls ride the GSPMD tensor-parallel
+    # layers as always.  Config fields, NOT ambient state: the mesh is
+    # part of the module hash, so a different topology is a different
+    # executable (graftlint trace-hygiene).  Set by
+    # serving.PagedEngine(mesh=); paged-only, both-or-neither.
+    kv_shard_axis: Optional[str] = None
+    kv_mesh: Any = None                     # jax.sharding.Mesh
     # flash-attention kernel tile sizes; None = the kernel's seq-aware
     # default (512 at short seq — isolated-op sweeps can mislead: in
     # the full rematted model 512/512 measures fastest at s=512 — and
@@ -225,6 +241,28 @@ class TransformerConfig:
             # unknown names / fp8 on a build without float8_e4m3fn
             # raise here, at config time
             kv_quant_spec(self.kv_dtype)
+        if self.kv_shard_axis is not None or self.kv_mesh is not None:
+            if self.kv_cache != "paged":
+                raise ValueError(
+                    "kv_shard_axis / kv_mesh require kv_cache='paged' "
+                    "— tensor-parallel serving shards the paged pool "
+                    "on its kv_heads axis; the dense slab is "
+                    "single-chip")
+            if self.kv_shard_axis is None or self.kv_mesh is None:
+                raise ValueError(
+                    "kv_shard_axis and kv_mesh come together: the "
+                    "axis names WHERE the pool shards, the mesh says "
+                    "over WHICH chips")
+            size = dict(self.kv_mesh.shape).get(self.kv_shard_axis)
+            if size is None:
+                raise ValueError(
+                    f"kv_shard_axis={self.kv_shard_axis!r} is not an "
+                    f"axis of kv_mesh (axes: "
+                    f"{tuple(self.kv_mesh.axis_names)})")
+            # the loud config-time divisibility gate: kv_heads % tp
+            # must be 0 (instead of a shape error deep inside
+            # shard_map) — the GQA group→shard mapping
+            tp_head_shards(self.num_heads, self.kv_heads, size)
         if self.num_moe_experts is not None:
             if self.num_moe_experts < 2:
                 raise ValueError(
@@ -374,6 +412,21 @@ def _cache_attention_blocked(q, keys, values, idx, scale, window=None,
     return o.reshape(b, s, h, d).astype(q.dtype)
 
 
+def _tp_pin(x, mesh, axis, dim):
+    """Pin ``x``'s sharding to ``axis`` on dimension ``dim`` (rest
+    replicated) — the paged pool's kv_heads placement under
+    tensor-parallel serving.  Keeps the pool scatter shard-local and
+    the cache leaves' out-shardings at a fixed point, so the engine's
+    retrace guards see one stable signature (the concrete
+    NamedSharding form is legal inside plain jit on every supported
+    jax)."""
+    spec = [None] * x.ndim
+    spec[dim] = axis
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(*spec)))
+
+
 def _rope_rows(x, cos_b, sin_b):
     """Half-rotation RoPE with PER-ROW position tables.
 
@@ -445,6 +498,16 @@ class ParallelAttention(nn.Module):
         NB, BS = cfg.kv_pool_blocks, cfg.kv_block_size
         MB = -(-S // BS)
         store_dt, qmax = kv_quant_spec(cfg.kv_dtype)
+        # tensor-parallel pool (kv_mesh/kv_shard_axis, validated
+        # together at config time): pool + scale leaves pin their
+        # kv_heads axis to the mesh so the scatter stays shard-local
+        # and attention routes through the shard_map path
+        tp_on = (cfg.kv_mesh is not None
+                 and dict(cfg.kv_mesh.shape).get(cfg.kv_shard_axis,
+                                                 1) > 1)
+        pin = ((lambda x, dim: _tp_pin(x, cfg.kv_mesh,
+                                       cfg.kv_shard_axis, dim))
+               if tp_on else (lambda x, dim: x))
         pk = self.variable("cache", "paged_key", jnp.zeros,
                            (hk, NB, BS, d),
                            k.dtype if store_dt is None else store_dt)
@@ -494,10 +557,12 @@ class ParallelAttention(nn.Module):
         kT = k.transpose(2, 0, 1, 3)             # (hk, b, s, d)
         vT = v.transpose(2, 0, 1, 3)
         if store_dt is None:
-            pk.value = pk.value.at[:, phys, off].set(kT)
-            pv.value = pv.value.at[:, phys, off].set(vT)
+            pk.value = pin(pk.value.at[:, phys, off].set(kT), 0)
+            pv.value = pin(pv.value.at[:, phys, off].set(vT), 0)
             return paged_attention(q, pk.value, pv.value, bt.value,
-                                   cur.value, scale=d ** -0.5)
+                                   cur.value, scale=d ** -0.5,
+                                   mesh=cfg.kv_mesh,
+                                   shard_axis=cfg.kv_shard_axis)
         # quantize-on-write (chunked prefill and decode scatter are
         # this one path).  Scale discipline per (kv_head, page):
         # - RESET at a page's first write: pages always begin life at
@@ -545,16 +610,20 @@ class ParallelAttention(nn.Module):
         v_run = jnp.maximum(jax.lax.cummax(va, axis=2),
                             v_base[:, :, None])
         fresh = jnp.where(off == 0, phys, 0)                 # (b, s)
-        ks_new = ksc.value.at[:, fresh].set(0.0).at[:, phys].max(k_run)
-        vs_new = vsc.value.at[:, fresh].set(0.0).at[:, phys].max(v_run)
+        ks_new = pin(
+            ksc.value.at[:, fresh].set(0.0).at[:, phys].max(k_run), 0)
+        vs_new = pin(
+            vsc.value.at[:, fresh].set(0.0).at[:, phys].max(v_run), 0)
         ksc.value, vsc.value = ks_new, vs_new
-        pk.value = pk.value.at[:, phys, off].set(
-            quantize_kv(kT, ks_new[:, phys], qmax, store_dt))
-        pv.value = pv.value.at[:, phys, off].set(
-            quantize_kv(vT, vs_new[:, phys], qmax, store_dt))
+        pk.value = pin(pk.value.at[:, phys, off].set(
+            quantize_kv(kT, ks_new[:, phys], qmax, store_dt)), 0)
+        pv.value = pin(pv.value.at[:, phys, off].set(
+            quantize_kv(vT, vs_new[:, phys], qmax, store_dt)), 0)
         return paged_attention(q, pk.value, pv.value, bt.value,
                                cur.value, scale=d ** -0.5,
-                               k_scales=ks_new, v_scales=vs_new)
+                               k_scales=ks_new, v_scales=vs_new,
+                               mesh=cfg.kv_mesh,
+                               shard_axis=cfg.kv_shard_axis)
 
     @nn.compact
     def __call__(self, x, *, mask_bias=None, deterministic: bool = True,

@@ -83,23 +83,40 @@ def _store(key: str, value: int) -> None:
         pass  # read-only FS: keep the in-memory entry
 
 
-def _key(op: str, width: int, dtype) -> str:
-    return f"{_device_key()}/{op}/w{width}/{dtype}"
+def _key(op: str, width: int, dtype, kv_heads=None) -> str:
+    """Cache key.  ``kv_heads`` (paged_attention only) qualifies the
+    entry with the PER-SHARD kv-head count the sweep ran at: a
+    tensor-parallel serving engine gathers ``kv_heads / tp`` heads'
+    pages per chip, so its measured-best page size is a different
+    quantity than the full-head-count winner — the two must never
+    alias (ISSUE 13 satellite)."""
+    base = f"{_device_key()}/{op}/w{width}/{dtype}"
+    if kv_heads is not None:
+        base += f"/kvh{int(kv_heads)}"
+    return base
 
 
-def cached_block_rows(op: str, width: int, dtype) -> Optional[int]:
+def cached_block_rows(op: str, width: int, dtype,
+                      kv_heads: Optional[int] = None) -> Optional[int]:
     """Measured best block-rows for ``op`` at ``width``, or None if
-    this (device, op, width, dtype) was never tuned."""
-    return _load().get(_key(op, width, dtype))
+    this (device, op, width, dtype[, kv_heads]) was never tuned.
+    ``kv_heads`` applies to the paged-attention entries only (the
+    per-shard head count — see :func:`_key`); the row-wise ops ignore
+    it."""
+    return _load().get(_key(op, width, dtype, kv_heads=kv_heads))
 
 
-def cached_paged_pair(width: int, dtype) -> Optional[tuple]:
+def cached_paged_pair(width: int, dtype,
+                      kv_heads: Optional[int] = None) -> Optional[tuple]:
     """Measured best ``(block_size, kv_dtype)`` pair for the paged
-    decode step at head_dim ``width`` and COMPUTE dtype ``dtype``
-    (``kv_dtype`` is ``None`` when the unquantized pool won), or None
-    if :func:`tune_paged_attention` never ran its joint sweep here.
-    ``PagedEngine(block_size=0, kv_dtype="auto")`` adopts this pair."""
-    val = _load().get(_key("paged_attention_pair", width, dtype))
+    decode step at head_dim ``width``, COMPUTE dtype ``dtype`` and
+    (per-shard) ``kv_heads`` (``kv_dtype`` is ``None`` when the
+    unquantized pool won), or None if :func:`tune_paged_attention`
+    never ran its joint sweep here.
+    ``PagedEngine(block_size=0, kv_dtype="auto")`` adopts this pair,
+    querying with its own shard's head count."""
+    val = _load().get(_key("paged_attention_pair", width, dtype,
+                           kv_heads=kv_heads))
     if val is None:
         return None
     bs, kvd = val
@@ -269,19 +286,27 @@ def tune_paged_attention(n_rows: int = 8, width: int = 128,
 
     - per-STORAGE-dtype block-size winners under the engine's
       ``block_size=0`` lookup key (device, "paged_attention",
-      head_dim, storage dtype) — ``kv_dtype=None`` keys the compute
-      dtype, exactly as before;
+      head_dim, storage dtype, **kv_heads**) — ``kv_dtype=None`` keys
+      the compute dtype, and the kv-head count qualifies every entry
+      so a tensor-parallel engine (which sweeps and serves at its
+      per-shard ``kv_heads / tp``) never adopts a winner measured at
+      full head count;
     - the joint ``(block_size, kv_dtype)`` winner under
-      "paged_attention_pair" keyed on the COMPUTE dtype, which
-      ``PagedEngine(block_size=0, kv_dtype="auto")`` adopts via
+      "paged_attention_pair" keyed on the COMPUTE dtype (+ kv_heads),
+      which ``PagedEngine(block_size=0, kv_dtype="auto")`` adopts via
       :func:`cached_paged_pair`.
+
+    A TP deployment therefore sweeps with ``kv_heads`` set to the
+    model's ``kv_heads // tp`` (what one chip actually serves).
 
     Returns the joint winner as ``(block_size, kv_dtype)``.  From the
     CLI pass the model's head_dim as ``--widths`` (NOT the hidden
-    size) and the serving batch as ``--rows``::
+    size), the serving batch as ``--rows``, and the PER-SHARD kv-head
+    count as ``--kv-heads`` (``kv_heads // tp`` for a TP deployment —
+    the engine looks the winner up under that count)::
 
         python -m apex_tpu.ops.autotune --ops paged_attention \\
-            --widths 128 --rows 16
+            --widths 128 --rows 16 --kv-heads 4
     """
     import jax
     import jax.numpy as jnp
@@ -338,11 +363,16 @@ def tune_paged_attention(n_rows: int = 8, width: int = 128,
             lambda bs, kvd=kvd: build(bs, kvd), candidates)
         if best_bs is None:
             continue
-        _store(_key("paged_attention", width, key_dt), best_bs)
+        # keyed on the swept kv-head count: a TP engine queries with
+        # its PER-SHARD count (kv_heads / tp) and must only find an
+        # entry swept at that count — sweep once per shard width
+        _store(_key("paged_attention", width, key_dt,
+                    kv_heads=kv_heads), best_bs)
         if best_dt_s < best_pair_dt:
             best_pair, best_pair_dt = (best_bs, kvd), best_dt_s
     if best_pair is not None:
-        _store(_key("paged_attention_pair", width, str(dt)),
+        _store(_key("paged_attention_pair", width, str(dt),
+                    kv_heads=kv_heads),
                [best_pair[0], best_pair[1] or "none"])
     return best_pair
 
@@ -354,6 +384,11 @@ def main(argv=None):
     p.add_argument("--widths", type=int, nargs="+", default=[1024])
     p.add_argument("--rows", type=int, default=8192)
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--kv-heads", type=int, default=8,
+                   help="paged_attention only: the kv-head count the "
+                        "sweep (and its cache keys) run at — for a "
+                        "tensor-parallel deployment pass the model's "
+                        "kv_heads // tp, what ONE chip serves")
     p.add_argument("--ops", nargs="+", default=["layer_norm", "softmax"],
                    choices=["layer_norm", "softmax", "batch_norm",
                             "paged_attention"])
@@ -364,7 +399,10 @@ def main(argv=None):
                     "softmax": tune_softmax,
                     "batch_norm": tune_batch_norm,
                     "paged_attention": tune_paged_attention}[op]
-            best = tune(n_rows=args.rows, width=width, dtype=args.dtype)
+            kw = ({"kv_heads": args.kv_heads}
+                  if op == "paged_attention" else {})
+            best = tune(n_rows=args.rows, width=width,
+                        dtype=args.dtype, **kw)
             if op == "paged_attention":
                 bs, kvd = best if best else (None, None)
                 print(f"{op} w={width}: best block_size={bs} "

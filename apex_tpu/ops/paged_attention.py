@@ -89,7 +89,27 @@ conventions:
 The *block size itself* is the tunable (the analogue of the row-wise
 kernels' block-rows): sweep it offline with
 ``apex_tpu.ops.autotune.tune_paged_attention`` and the serving engine
-picks the measured winner up by default.
+picks the measured winner up by default — the cache entry is keyed on
+the PER-SHARD ``kv_heads`` count, so a tensor-parallel engine never
+adopts a block size swept at full head count.
+
+**Tensor-parallel pool (``mesh=``/``shard_axis=``)**: one serving
+replica can span M chips (the ISSUE-13 tentpole) by sharding the pool
+on the ``kv_heads`` axis — each chip owns ``kv_heads / M`` heads'
+pages (and their per-(kv_head, page) quant scales, which carry the
+same leading axis and shard with them) while the block table and
+lengths stay **replicated**, so the host-side allocator / refcount /
+trie logic never learns about the mesh.  With both arguments set, the
+op runs through ``jax.shard_map`` over ``shard_axis``: every chip
+executes the ordinary kernel (Pallas on TPU, gather reference
+elsewhere) on its local head slice — attention is embarrassingly
+parallel over kv heads, so the sharded step needs NO collective here
+(the per-layer all-reduces live in the surrounding RowParallel
+projections).  Queries shard by the matching GQA grouping: q head
+``i`` belongs to kv group ``i // (num_heads/kv_heads)``, so a
+contiguous shard of ``num_heads/M`` q heads sees exactly its shard's
+kv heads (:func:`tp_head_shards` is the one mapping, validated loudly
+at config time when ``kv_heads % M != 0``).
 """
 
 from __future__ import annotations
@@ -106,7 +126,7 @@ from apex_tpu.ops._dispatch import resolve_impl
 
 __all__ = ["paged_attention", "paged_attention_reference",
            "kv_quant_spec", "kv_store_bytes_per_token", "quantize_kv",
-           "quantize_kv_pages"]
+           "quantize_kv_pages", "tp_head_shards"]
 
 _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634
@@ -219,6 +239,68 @@ def quantize_kv_pages(k_pages, v_pages, kv_dtype):
     kq = quantize_kv(k_pages, ks[:, :, None], qmax, store_dt)
     vq = quantize_kv(v_pages, vs[:, :, None], qmax, store_dt)
     return kq, vq, ks, vs
+
+
+def tp_head_shards(num_heads: int, kv_heads: int, tp: int):
+    """The GQA group→shard mapping of the tensor-parallel paged pool.
+
+    Shard ``j`` of ``tp`` owns q heads ``[j·h/tp, (j+1)·h/tp)`` and kv
+    heads ``[j·hk/tp, (j+1)·hk/tp)`` — contiguous ranges, because q
+    heads are stored g-major (head ``i`` attends kv group
+    ``i // (h/hk)``, both qkv layouts — see
+    ``models/transformer.py::ParallelAttention``), so an even split of
+    the kv heads splits the q heads at exactly the matching group
+    boundaries and every shard's attention is self-contained.  Returns
+    ``[((q_lo, q_hi), (kv_lo, kv_hi)), ...]`` per shard; raises the
+    loud config-time ``ValueError`` when ``kv_heads % tp != 0`` (the
+    alternative is a shape error deep inside shard_map).
+    """
+    num_heads, kv_heads, tp = int(num_heads), int(kv_heads), int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if num_heads % kv_heads:
+        raise ValueError(
+            f"kv_heads ({kv_heads}) must divide num_heads "
+            f"({num_heads})")
+    if kv_heads % tp:
+        raise ValueError(
+            f"kv_heads ({kv_heads}) must be divisible by the "
+            f"tensor-parallel degree ({tp}) — the paged KV pool "
+            f"shards on the kv_heads axis, one equal slice per chip "
+            f"(GQA groups cannot straddle shards); choose tp from "
+            f"the divisors of kv_heads")
+    rep = num_heads // kv_heads
+    hkl = kv_heads // tp
+    return [((j * hkl * rep, (j + 1) * hkl * rep),
+             (j * hkl, (j + 1) * hkl)) for j in range(tp)]
+
+
+def _run_sharded(q, k_pages, v_pages, tables, lengths, scale,
+                 implementation, k_scales, v_scales, mesh, axis):
+    """shard_map wrapper: each chip runs the unsharded op on its
+    kv-head slice (pool + scales sharded on axis 0, q on its head
+    axis, tables/lengths replicated — no collective in here)."""
+    _b, _s, h, _d = q.shape
+    hk = k_pages.shape[0]
+    tp_head_shards(h, hk, mesh.shape[axis])   # loud divisibility check
+    P = jax.sharding.PartitionSpec
+    q_spec = P(None, None, axis, None)
+    pool_spec = P(axis, None, None, None)
+    rep_spec = P()
+    in_specs = [q_spec, pool_spec, pool_spec, rep_spec, rep_spec]
+    args = [q, k_pages, v_pages, tables, lengths]
+    if k_scales is not None:
+        in_specs += [P(axis, None), P(axis, None)]
+        args += [k_scales, v_scales]
+
+    def local(q, kp, vp, bt, ln, *scales):
+        ks, vs = scales if scales else (None, None)
+        return paged_attention(q, kp, vp, bt, ln, scale=scale,
+                               implementation=implementation,
+                               k_scales=ks, v_scales=vs)
+
+    return jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=q_spec, check_vma=False)(*args)
 
 
 def _is_quantized_pool(dtype) -> bool:
@@ -448,9 +530,20 @@ def _run_paged(q4, k_pages, v_pages, tables, lengths, scale, interpret,
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
                     scale: Optional[float] = None,
                     implementation: Optional[str] = None,
-                    k_scales=None, v_scales=None):
+                    k_scales=None, v_scales=None,
+                    mesh=None, shard_axis: Optional[str] = None):
     """Attention of chunk queries over a paged KV pool (shapes in the
     module docstring).
+
+    With ``mesh`` and ``shard_axis`` both set (and the axis larger
+    than 1), the op runs tensor-parallel through ``jax.shard_map``:
+    the pool (and quant scales) shard on their leading ``kv_heads``
+    axis, queries on their head axis by the matching GQA grouping
+    (:func:`tp_head_shards`), block tables and lengths replicated —
+    each chip attends over exactly its own head slice's pages, no
+    collective inside the op.  The GLOBAL shapes are unchanged;
+    ``kv_heads`` must be divisible by the axis size (loud
+    ``ValueError`` otherwise).
 
     Inference-only (the decode path has no backward); the chunk's own
     K/V must already be written into the pool.  ``s > 1`` serves both
@@ -506,6 +599,11 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
             f"k_scales/v_scales only apply to quantized pools; pages "
             f"are {k_pages.dtype}")
     scale = (d ** -0.5) if scale is None else float(scale)
+    if shard_axis is not None and mesh is not None \
+            and mesh.shape.get(shard_axis, 1) > 1:
+        return _run_sharded(q, k_pages, v_pages, block_tables,
+                            lengths, scale, implementation,
+                            k_scales, v_scales, mesh, shard_axis)
     pallas_ok = (bs % 8 == 0 and d % 8 == 0
                  and (quantized
                       or q.dtype == k_pages.dtype == v_pages.dtype))

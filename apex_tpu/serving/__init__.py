@@ -14,7 +14,13 @@ path, in two cache layouts:
   mapped once per replica instead of once per tenant) and
   **speculative decoding** (``spec_tokens=K`` — host-side
   prompt-lookup drafts verified K-at-a-time in one mixed-step
-  application, accepted-prefix + bonus token per step);
+  application, accepted-prefix + bonus token per step) and
+  **tensor-parallel replicas** (``tp=M`` / ``mesh=`` — ONE replica
+  spans M chips: weights ride the GSPMD TP layers, the pool shards on
+  ``kv_heads`` via the shard_map path of
+  :func:`~apex_tpu.ops.paged_attention.paged_attention`, block
+  tables / trie / allocator stay replicated host logic — the first
+  path that serves a model too big for one chip);
 - **dense** (:class:`Engine`, the fallback): the fixed
   ``max_slots × max_seq_len`` slotted slab with bucket-padded prefill.
 
@@ -50,6 +56,7 @@ from apex_tpu.serving.engine import (
     PagedEngine,
     StepOutput,
     prompt_lookup_draft,
+    tp_mesh,
 )
 from apex_tpu.serving.cache import (
     BlockAllocator,
@@ -82,6 +89,7 @@ __all__ = [
     "PrefixTrie",
     "chain_digests",
     "prompt_lookup_draft",
+    "tp_mesh",
     "DEFAULT_BUCKETS",
     "Scheduler",
     "Request",

@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from apex_tpu.core.mesh import TENSOR_AXIS
 from apex_tpu.resilience import faults
 from apex_tpu.serving.engine import DEFAULT_BUCKETS, Engine, PagedEngine
 from apex_tpu.serving.scheduler import QueueFull, Request, Scheduler
@@ -202,7 +203,8 @@ class InferenceServer:
                  admit_headroom: Optional[int] = None,
                  share_prefixes: bool = False,
                  spec_tokens: int = 0, spec_ngram: int = 3,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 tp: int = 0, mesh: Optional[Any] = None):
         if kv_cache == "paged":
             if prompt_buckets is not None:
                 raise ValueError(
@@ -210,6 +212,17 @@ class InferenceServer:
                     "— chunked prefill admits any prompt length; "
                     "tune prefill_chunk (step width) and pool_tokens "
                     "instead")
+            if tp and mesh is not None:
+                # mesh may be a Mesh or an int (the engine accepts
+                # both); either way its tensor width must agree with
+                # an explicit tp
+                mesh_tp = (mesh if isinstance(mesh, int)
+                           else dict(mesh.shape).get(TENSOR_AXIS, 1))
+                if mesh_tp != tp:
+                    raise ValueError(
+                        f"tp={tp} disagrees with mesh "
+                        f"({TENSOR_AXIS} axis {mesh_tp}) — pass one "
+                        f"or make them match")
             # chunked prefill needs a chunk width; 0 (the dense
             # single-call convention) maps to the engine default
             self.engine: Any = PagedEngine(
@@ -219,7 +232,9 @@ class InferenceServer:
                 admit_headroom=admit_headroom,
                 share_prefixes=share_prefixes,
                 spec_tokens=spec_tokens, spec_ngram=spec_ngram,
-                kv_dtype=kv_dtype)
+                kv_dtype=kv_dtype,
+                mesh=(mesh if mesh is not None
+                      else (tp if tp and tp > 1 else None)))
         elif kv_cache == "dense":
             if share_prefixes or spec_tokens:
                 raise ValueError(
@@ -227,6 +242,13 @@ class InferenceServer:
                     "kv_cache='paged' — the dense slab has no page "
                     "pool to share and no mixed multi-token step to "
                     "verify drafts in")
+            if (tp and tp > 1) or mesh is not None:
+                raise ValueError(
+                    "tp / mesh require kv_cache='paged' — "
+                    "tensor-parallel serving shards the paged pool "
+                    "on its kv_heads axis (and the matmuls over the "
+                    "GSPMD layers); the dense slab engine is "
+                    "single-chip")
             if kv_dtype is not None:
                 raise ValueError(
                     "kv_dtype requires kv_cache='paged' — quantized "
@@ -630,8 +652,15 @@ class InferenceServer:
 
     def _emit_metrics(self, now: float) -> None:
         dt = max(now - (self._window_t0 or now), 1e-9)
+        chips = int(getattr(self.engine, "chips_per_replica", 1))
         payload = {
             "tokens_per_sec": self._window_tokens / dt,
+            # the Gemma-paper serving protocol reports throughput PER
+            # CHIP — a tensor-parallel replica (chips > 1) divides by
+            # its mesh width so 1×M and M×1 deployments compare at
+            # equal chip count
+            "tokens_per_sec_per_chip": self._window_tokens / dt / chips,
+            "chips_per_replica": chips,
             "occupancy": self.scheduler.occupancy,
             "queue_depth": self.scheduler.queue_depth,
             "tokens_total": self._tokens_emitted,
@@ -709,7 +738,15 @@ class InferenceServer:
             "drain_evicted": self._drain_evicted,
             "preempts": self.scheduler.preempts,
             "error": None if error is None else repr(error),
+            # chips this ONE replica spans (tensor-parallel paged
+            # engine; 1 everywhere else) — the fleet's capacity math
+            # and the per-chip throughput protocol both read it
+            "chips_per_replica": int(
+                getattr(self.engine, "chips_per_replica", 1)),
         }
+        mesh_shape = getattr(self.engine, "mesh_shape", None)
+        if mesh_shape:
+            out["mesh_shape"] = mesh_shape
         blocks_total = getattr(self.engine, "blocks_total", None)
         if blocks_total:
             out["blocks_in_use"] = self.engine.blocks_in_use

@@ -465,6 +465,63 @@ class PrefixTrie:
         return pages
 
 
+def _tp_spec_for_leaf(name: str, ndim: int, axis: str):
+    """PartitionSpec of one paged-cache leaf under tensor-parallel
+    serving: the K/V pool leaves shard their ``kv_heads`` dim (at
+    ``ndim - 4`` — the scanned layer stack prepends a layer axis, the
+    unrolled form doesn't), the per-(kv_head, page) quant-scale leaves
+    shard the same dim at ``ndim - 2``, and EVERYTHING else — block
+    tables, cursors, chunk_lens, position_index, the SlotState twin —
+    is replicated, which is what keeps the engine's host-side
+    allocator / refcount / trie logic mesh-oblivious."""
+    import jax.sharding as shd
+
+    if name in ("paged_key", "paged_value"):
+        dim = ndim - 4
+    elif name in ("key_scales", "value_scales"):
+        dim = ndim - 2
+    else:
+        return shd.PartitionSpec()
+    spec = [None] * ndim
+    spec[dim] = axis
+    return shd.PartitionSpec(*spec)
+
+
+def paged_pool_shardings(cache: Any, mesh, axis: str) -> Any:
+    """``NamedSharding`` tree matching ``cache``: pool/scale leaves
+    sharded on kv_heads over ``axis``, the rest replicated (see
+    :func:`_tp_spec_for_leaf`)."""
+    import jax.sharding as shd
+
+    def f(path, leaf):
+        return shd.NamedSharding(
+            mesh, _tp_spec_for_leaf(_leaf_name(path),
+                                    jnp.ndim(leaf), axis))
+
+    return jax.tree_util.tree_map_with_path(f, cache)
+
+
+def shard_paged_cache(cache: Any, mesh, axis: str) -> Any:
+    """Place a paged cache tree on the replica's mesh (host-side
+    ``device_put`` at engine construction)."""
+    return jax.device_put(cache, paged_pool_shardings(cache, mesh,
+                                                      axis))
+
+
+def constrain_paged_cache(cache: Any, mesh, axis: str) -> Any:
+    """The in-trace twin of :func:`shard_paged_cache`:
+    ``with_sharding_constraint`` every leaf to the same placement, so
+    the jitted step's OUTPUT cache lands exactly where its input was
+    committed — shardings reach a fixed point and the retrace guards
+    (budget 1) never see a second signature."""
+    return jax.tree.map(jax.lax.with_sharding_constraint, cache,
+                        paged_pool_shardings(cache, mesh, axis))
+
+
+__all__ += ["paged_pool_shardings", "shard_paged_cache",
+            "constrain_paged_cache"]
+
+
 def set_paged_leaves(cache: Any, tables, cursors,
                      chunk_lens=None) -> Any:
     """Overwrite the paged cache tree's ``block_tables`` and cursor

@@ -49,17 +49,85 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.core.mesh import TENSOR_AXIS
 from apex_tpu.models.generate import (
     apply_decode,
     cache_shapes,
     prefill_tokens,
 )
+from apex_tpu.ops.paged_attention import tp_head_shards
 from apex_tpu.serving import cache as slot_cache
 from apex_tpu.utils import tracecheck
 from apex_tpu.utils.metrics import counters
 
 __all__ = ["Engine", "PagedEngine", "StepOutput", "sample_dynamic",
-           "prompt_lookup_draft", "DEFAULT_BUCKETS"]
+           "prompt_lookup_draft", "DEFAULT_BUCKETS", "tp_mesh"]
+
+
+def tp_mesh(tp: int, devices=None):
+    """A one-replica tensor-parallel serving mesh: ``tp`` chips on the
+    ``tensor`` axis (every other axis 1).
+
+    ``devices`` defaults to the first ``tp`` of ``jax.devices()``; a
+    fleet packing N replicas × M chips onto one host passes each
+    replica its own device slice (``jax.devices()[i*M:(i+1)*M]``).
+    Never touches the library-global mesh (``set_current=False``) —
+    replicas own disjoint meshes, and serving must not hijack the
+    training topology."""
+    from apex_tpu.core.mesh import initialize_mesh
+
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    devices = list(jax.devices() if devices is None else devices)
+    if len(devices) < tp:
+        raise ValueError(
+            f"tp={tp} needs {tp} devices, only {len(devices)} "
+            f"available")
+    return initialize_mesh(tensor_model_parallel_size=tp,
+                           devices=devices[:tp], set_current=False)
+
+
+def _shard_params_for_tp(variables, mesh):
+    """Place one replica's weights on its mesh: flax ``Partitioned``
+    boxes shard per their annotations (the GSPMD tensor-parallel
+    layers mark qkv/out/mlp kernels over the ``tensor`` axis — this is
+    where a model too big for one chip actually fits), axes absent
+    from the mesh are dropped, a dim the axis size doesn't divide
+    falls back to replicated, and plain (unboxed) leaves replicate."""
+    from flax.core import meta
+
+    repl = jax.sharding.NamedSharding(mesh,
+                                      jax.sharding.PartitionSpec())
+    axes = set(mesh.axis_names)
+
+    def place(x):
+        if isinstance(x, meta.Partitioned):
+            names = tuple(n if n in axes else None for n in x.names)
+            sh = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(*names))
+            try:
+                return x.replace_boxed(jax.device_put(x.unbox(), sh))
+            except ValueError:
+                return x.replace_boxed(jax.device_put(x.unbox(),
+                                                      repl))
+        return jax.device_put(x, repl)
+
+    return jax.tree.map(place, variables,
+                        is_leaf=lambda x: isinstance(x,
+                                                     meta.Partitioned))
+
+
+def _pin_replicated(tree, mesh):
+    """In-trace: constrain every leaf of ``tree`` replicated over
+    ``mesh`` — the SlotState / sampling outputs' fixed point (see
+    ``serving.cache.constrain_paged_cache`` for why out-shardings
+    must be pinned under retrace budgets of 1)."""
+    repl = jax.sharding.NamedSharding(mesh,
+                                      jax.sharding.PartitionSpec())
+    return jax.tree.map(
+        lambda x: jax.lax.with_sharding_constraint(x, repl), tree)
+
 
 DEFAULT_BUCKETS: Tuple[int, ...] = (32, 128, 512)
 
@@ -548,10 +616,29 @@ class PagedEngine:
     (same bounded drift class as rescale-on-append; the rolled-back
     CODES are overwritten next step as usual).
 
+    **Tensor-parallel replica (``mesh=``, ISSUE 13)**: one engine can
+    span M chips — the first change that serves a model too big for
+    one.  Pass a :func:`tp_mesh` (or an int M) and the whole paged
+    datapath shards: weights per their GSPMD annotations
+    (ColumnParallel/RowParallel — XLA inserts the per-layer
+    all-reduces), the K/V pool (and its quant-scale leaves) on the
+    ``kv_heads`` axis through :func:`~apex_tpu.ops.paged_attention`'s
+    shard_map path, while block tables, cursors and ``SlotState``
+    stay REPLICATED — so the allocator, refcounts, CoW forking,
+    preemption, the prefix trie, drafting and the scheduler above are
+    byte-for-byte the single-chip host logic.  Prefix sharing,
+    speculative decoding and quantized pages therefore ride the
+    sharded pool unchanged, at the same 5×1 trace budget (step
+    outputs pin their shardings to the committed placement, so the
+    signatures reach a fixed point).  ``kv_heads % M != 0`` raises a
+    loud ``ValueError`` here, at construction.
+
     ``block_size=0`` consults the
     :mod:`~apex_tpu.ops.autotune` table (op ``"paged_attention"``,
-    keyed on head_dim + the pool's STORAGE dtype) and falls back
-    to 16.  ``pool_tokens`` defaults to ``max_slots × max_seq_len`` —
+    keyed on head_dim + the pool's STORAGE dtype + the PER-SHARD
+    kv_heads count — a TP engine must not adopt a block size swept at
+    full head count) and falls back to 16.
+    ``pool_tokens`` defaults to ``max_slots × max_seq_len`` —
     the dense slab's footprint (converted into quantized tokens at
     equal bytes when ``kv_dtype`` is set); shrink it to trade capacity
     for memory (admission token-gates and preemption backstops the
@@ -568,7 +655,8 @@ class PagedEngine:
                  share_prefixes: bool = False,
                  spec_tokens: int = 0,
                  spec_ngram: int = 3,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 mesh=None):
         cfg = getattr(model, "cfg", None)
         if cfg is None or not hasattr(cfg, "max_seq_len"):
             raise ValueError(
@@ -594,6 +682,31 @@ class PagedEngine:
         if spec_ngram < 1:
             raise ValueError(
                 f"spec_ngram must be >= 1, got {spec_ngram}")
+        # tensor-parallel replica (ISSUE 13): an int builds a
+        # tp-wide mesh over the first tp devices; a Mesh is used as
+        # given (the fleet hands each replica its own device slice).
+        # A mesh whose tensor axis is 1 is the single-chip engine.
+        if isinstance(mesh, int):
+            mesh = tp_mesh(mesh) if mesh > 1 else None
+        if mesh is not None and TENSOR_AXIS not in mesh.axis_names:
+            # loud, like every other TP config mistake: silently
+            # serving single-chip on a mesh with no tensor axis would
+            # let the user believe they are tensor-parallel
+            raise ValueError(
+                f"mesh has no {TENSOR_AXIS!r} axis (axes: "
+                f"{tuple(mesh.axis_names)}) — build the serving mesh "
+                f"with serving.tp_mesh(tp, devices), or pass an int")
+        tp = (1 if mesh is None
+              else int(dict(mesh.shape).get(TENSOR_AXIS, 1)))
+        if tp <= 1:
+            mesh, tp = None, 1
+        else:
+            # the loud config-time gate: kv_heads % tp == 0 (the GQA
+            # group→shard mapping), instead of a shape error deep
+            # inside shard_map
+            tp_head_shards(cfg.num_heads, cfg.kv_heads, tp)
+        self.mesh = mesh
+        self.tp = tp
         self.model = model
         self.max_slots = int(max_slots)
         self.max_seq_len = int(cfg.max_seq_len)
@@ -609,6 +722,11 @@ class PagedEngine:
         from apex_tpu.ops import autotune
         from apex_tpu.ops.paged_attention import (
             kv_quant_spec, kv_store_bytes_per_token)
+        # autotune entries are keyed on the PER-SHARD kv_heads count:
+        # a TP engine's decode step gathers kv_heads/tp heads' pages
+        # per chip, so it must never adopt a block size swept at full
+        # head count (and vice versa)
+        shard_kv_heads = int(cfg.kv_heads) // self.tp
         if kv_dtype == "auto":
             # adopt the (block_size, kv_dtype) pair a joint
             # tune_paged_attention sweep measured best — only together
@@ -616,7 +734,8 @@ class PagedEngine:
             # caller is overriding the tuner, so we don't silently
             # flip their numerics either)
             pair = (autotune.cached_paged_pair(
-                int(cfg.head_dim), str(jnp.dtype(cfg.dtype)))
+                int(cfg.head_dim), str(jnp.dtype(cfg.dtype)),
+                kv_heads=shard_kv_heads)
                 if block_size == 0 else None)
             kv_dtype = pair[1] if pair else None
             if pair and block_size == 0:
@@ -633,7 +752,8 @@ class PagedEngine:
             key_dt = (str(jnp.dtype(cfg.dtype)) if store_dt is None
                       else str(jnp.dtype(store_dt)))
             block_size = autotune.cached_block_rows(
-                "paged_attention", int(cfg.head_dim), key_dt) or 16
+                "paged_attention", int(cfg.head_dim), key_dt,
+                kv_heads=shard_kv_heads) or 16
         if block_size < 1:
             raise ValueError(
                 f"block_size must be >= 1, got {block_size}")
@@ -684,11 +804,28 @@ class PagedEngine:
         # never collide with a dense model's in any jit cache
         self._paged_model = type(model)(cfg=dataclasses.replace(
             cfg, kv_cache="paged", kv_block_size=self.block_size,
-            kv_pool_blocks=num_blocks, kv_dtype=self.kv_dtype))
+            kv_pool_blocks=num_blocks, kv_dtype=self.kv_dtype,
+            kv_mesh=self.mesh,
+            kv_shard_axis=(TENSOR_AXIS if self.mesh is not None
+                           else None)))
         shapes = cache_shapes(self._paged_model, self.max_slots)
         self.cache = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes)
         self.state = slot_cache.init_slot_state(self.max_slots)
+        if self.mesh is not None:
+            # commit the replica onto its mesh: weights per their
+            # GSPMD annotations, the pool sharded on kv_heads, block
+            # tables / cursors / slot state replicated.  The step
+            # functions pin their outputs to the SAME placement, so
+            # shardings reach a fixed point and the retrace budgets
+            # of 1 hold exactly as on one chip.
+            self._variables = _shard_params_for_tp(self._variables,
+                                                   self.mesh)
+            self.cache = slot_cache.shard_paged_cache(
+                self.cache, self.mesh, TENSOR_AXIS)
+            self.state = jax.device_put(
+                self.state, jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec()))
         mb = slot_cache.blocks_for(self.max_seq_len, self.block_size)
         self._tables = np.zeros((self.max_slots, mb), np.int32)
         self._cursors = np.zeros((self.max_slots,), np.int32)
@@ -700,6 +837,18 @@ class PagedEngine:
     def _build(self) -> None:
         model = self._paged_model
         vocab = self.vocab_size
+        mesh = self.mesh
+
+        def pin_out(cache, state):
+            # TP fixed point: outputs land exactly where the inputs
+            # were committed (pool on kv_heads, everything else
+            # replicated), so feeding them back never changes the jit
+            # signature — the retrace budgets of 1 stay exact
+            if mesh is None:
+                return cache, state
+            return (slot_cache.constrain_paged_cache(
+                        cache, mesh, TENSOR_AXIS),
+                    _pin_replicated(state, mesh))
 
         def step_fn(variables, cache, state, tables, cursors, feed,
                     n_tokens, is_prefill, emit):
@@ -733,6 +882,7 @@ class PagedEngine:
                 produced=produced,
                 active=state.active & ~finished,
                 rng=jnp.where(emit[:, None], split[:, 1], state.rng))
+            cache, state = pin_out(cache, state)
             return cache, state, nxt, finished
 
         spec_w = 1 + self.spec_tokens
@@ -798,16 +948,21 @@ class PagedEngine:
                 produced=produced,
                 active=state.active & ~finished,
                 rng=new_rng)
+            cache, state = pin_out(cache, state)
             return cache, state, sampled, n_emit, finished
 
         def admit(state, slot, tok, budget, temperature, top_k, top_p,
                   eos_id, seed):
-            return slot_cache.admit_slot(
+            state = slot_cache.admit_slot(
                 state, slot, tok, budget, temperature, top_k, top_p,
                 eos_id, seed)
+            return (state if mesh is None
+                    else _pin_replicated(state, mesh))
 
         def release(state, slot):
-            return slot_cache.release_slot(state, slot)
+            state = slot_cache.release_slot(state, slot)
+            return (state if mesh is None
+                    else _pin_replicated(state, mesh))
 
         # exact budgets: decode/spec/admit/release = 1 and the dense
         # engine's per-bucket prefills collapse to ONE mixed-step
@@ -1213,6 +1368,22 @@ class PagedEngine:
             self._drafter = drafter
 
     # ------------------------------------------------------------ gauges
+    @property
+    def chips_per_replica(self) -> int:
+        """Chips this ONE replica spans (the tensor-parallel degree;
+        1 = the single-chip engine) — per-chip throughput in the
+        Gemma-paper serving protocol divides by this."""
+        return self.tp
+
+    @property
+    def mesh_shape(self) -> Optional[dict]:
+        """``{axis: size}`` of the replica's mesh, or ``None`` on a
+        single chip (health()/fleet merged-view field)."""
+        if self.mesh is None:
+            return None
+        return {str(k): int(v) for k, v in dict(self.mesh.shape).items()
+                if int(v) > 1}
+
     @property
     def blocks_total(self) -> int:
         return self._alloc.blocks_total

@@ -1160,6 +1160,12 @@ class FleetRouter:
         bits = [int(h["kv_bits"]) for h in sweep if "kv_bits" in h]
         if bits:
             stats["kv_bits_min"] = min(bits)
+        # mesh view (ISSUE 13): a replica is no longer one chip — the
+        # fleet's capacity is N replicas × M chips, and per-chip
+        # throughput must divide by chips_total, not replicas_live
+        chips = [int(h.get("chips_per_replica", 1)) for h in sweep]
+        stats["chips_per_replica"] = max(chips, default=1)
+        stats["chips_total"] = sum(chips)
         stats.update(self.latency_summary())
         writer(writer.advance_step(),
                {f"fleet/{k}": float(v) for k, v in stats.items()})
@@ -1254,6 +1260,19 @@ class FleetRouter:
             "kv_dtypes": sorted({
                 str(h.get("kv_dtype") or "none") for h in sweep
                 if "kv_bits" in h}),
+            # mesh view (ISSUE 13): widest replica + total chips the
+            # fleet spans (N replicas × M chips — health gauges stay
+            # per-replica, so routing/breakers never changed), plus
+            # the distinct per-replica mesh shapes (a mixed fleet
+            # mid-resize legitimately reports several)
+            "chips_per_replica": max(
+                (int(h.get("chips_per_replica", 1)) for h in sweep),
+                default=1),
+            "chips_total": sum(
+                int(h.get("chips_per_replica", 1)) for h in sweep),
+            "mesh_shapes": sorted({
+                str(h["mesh_shape"]) for h in sweep
+                if h.get("mesh_shape")}),
             "supervisor_error": (None if self.supervisor_error is None
                                  else repr(self.supervisor_error)),
         }

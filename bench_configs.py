@@ -2188,7 +2188,8 @@ def _long_context_single():
 def _serving_traffic_model(*, num_layers, kv_heads, head_dim,
                            max_seq_len, live_tokens, slots,
                            block_size, dtype_bytes=2,
-                           shared_prefix_tokens=0, kv_dtype=None):
+                           shared_prefix_tokens=0, kv_dtype=None,
+                           tp=1, hidden_size=0):
     """Analytic per-step KV-cache traffic of the serving decode step —
     the measured defect behind the ISSUE-5 paged tentpole, in bytes:
 
@@ -2231,10 +2232,34 @@ def _serving_traffic_model(*, num_layers, kv_heads, head_dim,
     traffic (one 4-byte scalar per page per side — the kernel DMAs it
     through the same block-table prefetch).
 
+    With ``tp`` > 1 (ISSUE 13, tensor-parallel paged serving) one
+    replica spans ``tp`` chips: the pool shards on ``kv_heads``, so
+    each chip reads only its slice
+    (``paged_kv_read_bytes_per_step_per_chip`` = the paged count /
+    tp), and every decode step pays **ICI collective traffic** — the
+    two RowParallel all-reduces per layer (attention out-proj + MLP
+    down-proj) over the ``(slots, hidden_size)`` step activations.
+    The new ICI column counts them at the ring-all-reduce wire cost of
+    ``2·(tp-1)/tp`` × payload per chip (``ici_bytes_per_step_per_chip``;
+    ``ici_bytes_per_step`` sums the chips).  The vocab-parallel logits
+    all-reduce and the shard_map-internal attention (which needs NO
+    collective — kv heads are independent) are deliberately excluded:
+    the column isolates the per-layer activation collectives that
+    scale with depth, the term the 1×M vs M×1 A/B trades against
+    per-chip HBM reads.  ``hidden_size`` is required when ``tp > 1``.
+
     Both counts are K+V (×2) across all layers; the param stream
     (identical for both engines) is excluded — this model isolates the
     cache term the tentpole changes.
     """
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if tp > 1 and not hidden_size:
+        raise ValueError(
+            "hidden_size is required for the ICI column (tp > 1) — "
+            "the per-step collectives move (slots, hidden) "
+            "activations")
     per_tok = 2 * kv_heads * head_dim * dtype_bytes * num_layers
     pages = lambda t: -(-int(t) // int(block_size))   # noqa: E731
     live_pages = pages(live_tokens)
@@ -2261,23 +2286,41 @@ def _serving_traffic_model(*, num_layers, kv_heads, head_dim,
                  * kv_store_bytes_per_token(head_dim, block_size,
                                             kv_dtype))
         dense_bytes = slots * max_seq_len * per_tok
+        q_read = (slots * live_pages
+                  * (block_size * 2 * kv_heads * head_dim
+                     * store_bytes * num_layers + scale_per_page))
         quant = {
             "kv_dtype": str(kv_dtype),
             "kv_store_bytes_per_token_quantized": round(q_tok, 3),
             "kv_store_bytes_per_token_unquantized": int(per_tok),
             "paged_pool_tokens_at_equal_hbm": int(dense_bytes / q_tok),
             "quantized_capacity_multiplier": round(per_tok / q_tok, 3),
-            "paged_kv_read_bytes_per_step_quantized": int(
-                slots * live_pages
-                * (block_size * 2 * kv_heads * head_dim * store_bytes
-                   * num_layers + scale_per_page)),
+            "paged_kv_read_bytes_per_step_quantized": int(q_read),
+            # per-chip quantized twin of the TP column below: the
+            # sharded pool divides the (1-byte + scale) gather by tp —
+            # the unquantized per-chip key would overstate a quantized
+            # TP pool's HBM reads 2-4x, exactly the HBM-vs-ICI ratio
+            # this model quantifies
+            "paged_kv_read_bytes_per_step_per_chip_quantized": int(
+                q_read / tp),
         }
+    paged_read = slots * live_pages * block_size * per_tok
+    # ring all-reduce: each chip sends+receives 2·(tp-1)/tp of the
+    # payload; 2 RowParallel reduces per layer on the (slots, hidden)
+    # decode-step activations
+    ici_per_chip = (0 if tp == 1 else int(
+        2 * num_layers * slots * hidden_size * dtype_bytes
+        * 2 * (tp - 1) / tp))
     return {
         **quant,
+        "tp": tp,
+        "ici_bytes_per_step_per_chip": ici_per_chip,
+        "ici_bytes_per_step": ici_per_chip * tp,
+        "paged_kv_read_bytes_per_step_per_chip":
+            int(paged_read / tp),
         "dense_kv_read_bytes_per_step":
             int(slots * max_seq_len * per_tok),
-        "paged_kv_read_bytes_per_step":
-            int(slots * live_pages * block_size * per_tok),
+        "paged_kv_read_bytes_per_step": int(paged_read),
         "dense_pool_bytes": int(slots * max_seq_len * per_tok),
         "paged_pool_tokens": int(slots * max_seq_len),
         "live_tokens": int(live_tokens),
@@ -3400,6 +3443,149 @@ def bench_fleet_serving():
     })
 
 
+def bench_tp_serving():
+    """Tensor-parallel paged serving A/B (ISSUE 13): at EQUAL chip
+    count C, (a) C replicas × 1 chip behind a FleetRouter vs (b) ONE
+    replica × C chips (``InferenceServer(tp=C)`` — pool sharded on
+    kv_heads, matmuls over the GSPMD TP layers), reporting tokens/s
+    and TTFT p50/p99 *per chip* per the Gemma-paper protocol, with
+    the per-step ICI collective column of ``_serving_traffic_model``
+    populated for the TP row.  The M×1 fleet wins pure throughput
+    (zero ICI, C independent steps in flight) — the TP row's value is
+    CAPACITY: it serves a model C× too big for one chip, and the
+    A/B + traffic model quantify exactly what that costs per chip.
+
+    Env: BENCH_TP_CHIPS (2), BENCH_TP_REQUESTS (10),
+    BENCH_TP_PROMPT (8), BENCH_TP_TOKENS (16), BENCH_TP_SLOTS (2).
+    CPU smoke uses the tiny-GPT proxy over the virtual-device mesh;
+    the protocol (not the absolute numbers) is the artifact."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.serving import FleetRouter, InferenceServer
+
+    chips = int(os.environ.get("BENCH_TP_CHIPS", "2"))
+    if len(jax.devices()) < chips:
+        raise RuntimeError(
+            f"tp_serving needs {chips} devices, found "
+            f"{len(jax.devices())} — on CPU run with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            f"(the _run_all driver sets it)")
+    requests = int(os.environ.get("BENCH_TP_REQUESTS", "10"))
+    P = int(os.environ.get("BENCH_TP_PROMPT", "8"))
+    N = int(os.environ.get("BENCH_TP_TOKENS", "16"))
+    slots = int(os.environ.get("BENCH_TP_SLOTS", "2"))
+
+    cfg = GPTConfig.tiny(position_embedding="learned",
+                         scan_layers=True)
+    model = GPTModel(cfg)
+    params = {"params": model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 4), jnp.int32))["params"]}
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(P,)).astype(
+        np.int32) for _ in range(requests)]
+
+    def summarize(tokens, wall, lat, n_chips, extra):
+        ttft_p99 = lat.get("ttft_p99_s", 0.0) * 1e3
+        return {
+            "chips": n_chips,
+            "tokens_per_sec": round(tokens / wall, 1),
+            "tokens_per_sec_per_chip": round(
+                tokens / wall / n_chips, 1),
+            "ttft_p50_ms": round(lat.get("ttft_p50_s", 0.0) * 1e3, 1),
+            "ttft_p99_ms": round(ttft_p99, 1),
+            "wall_s": round(wall, 3),
+            **extra,
+        }
+
+    def run_fleet():
+        # C replicas × 1 chip: the pre-ISSUE-13 scaling axis.  Each
+        # replica's weights are COMMITTED to its own device so the
+        # jitted steps actually run there (uncommitted params would
+        # pile every replica onto device 0 and the per-chip division
+        # below would be fiction)
+        import itertools
+
+        devices = jax.devices()
+        idx = itertools.count()
+
+        def factory():
+            dev = devices[next(idx) % len(devices)]
+            return InferenceServer(
+                model, jax.device_put(params, dev), max_slots=slots,
+                kv_cache="paged", block_size=8, prefill_chunk=4)
+
+        router = FleetRouter(factory, replicas=chips,
+                             probe_interval=0.05)
+        with router:
+            t0 = time.perf_counter()
+            handles = [router.submit(p, max_new_tokens=N, seed=i)
+                       for i, p in enumerate(prompts)]
+            tokens = sum(len(h.result(timeout=600)) for h in handles)
+            wall = time.perf_counter() - t0
+            lat = router.latency_summary()
+            merged = router.health()
+        return summarize(tokens, wall, lat, chips, {
+            "layout": f"{chips}x1 (replicas x chips)",
+            "chips_total": merged["chips_total"],
+        })
+
+    def run_tp():
+        # 1 replica × C chips: one engine spans the mesh
+        server = InferenceServer(
+            model, params, max_slots=slots, kv_cache="paged",
+            block_size=8, prefill_chunk=4, tp=chips)
+        with server:
+            t0 = time.perf_counter()
+            handles = [server.submit(p, max_new_tokens=N, seed=i)
+                       for i, p in enumerate(prompts)]
+            tokens = sum(len(h.result(timeout=600)) for h in handles)
+            wall = time.perf_counter() - t0
+            lat = server.latency_summary()
+            health = server.health()
+        return summarize(tokens, wall, lat, chips, {
+            "layout": f"1x{chips} (replicas x chips)",
+            "chips_per_replica": health["chips_per_replica"],
+            "mesh_shape": str(health.get("mesh_shape")),
+        })
+
+    tm = _serving_traffic_model(
+        num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
+        head_dim=cfg.head_dim, max_seq_len=cfg.max_seq_len,
+        live_tokens=P + N, slots=slots, block_size=8,
+        dtype_bytes=jnp.dtype(cfg.dtype).itemsize,
+        tp=chips, hidden_size=cfg.hidden_size)
+    rows = {
+        f"{chips}x1_fleet": run_fleet(),
+        f"1x{chips}_tp": run_tp(),
+    }
+    _emit({
+        "metric": f"tp_serving_1x{chips}_tokens_per_sec_per_chip",
+        "value": rows[f"1x{chips}_tp"]["tokens_per_sec_per_chip"],
+        "unit": "tokens/sec/chip at equal chip count",
+        "requests": requests, "prompt": P, "budget": N,
+        "slots_per_replica": slots,
+        "rows": rows,
+        "traffic_model": tm,
+        "note": ("ISSUE-13 A/B at equal chip count: the M×1 fleet is "
+                 "the throughput ceiling (zero ICI), the 1×M TP row "
+                 "buys model CAPACITY (one replica spans the mesh; "
+                 "kv-head-sharded pool reads "
+                 f"{tm['paged_kv_read_bytes_per_step_per_chip']} "
+                 "B/step/chip vs "
+                 f"{tm['paged_kv_read_bytes_per_step']} single-chip) "
+                 "at the modeled ICI cost of "
+                 f"{tm['ici_bytes_per_step_per_chip']} B/step/chip "
+                 "(CPU smoke on the tiny-GPT proxy — protocol, not "
+                 "absolute throughput, is the artifact)"),
+    })
+
+
 # ----------------------------------------------------------------- driver
 
 LEGS = {
@@ -3420,6 +3606,7 @@ LEGS = {
     "quantized_kv_serving": bench_quantized_kv_serving,
     "resilience_overhead": bench_resilience_overhead,
     "fleet_serving": bench_fleet_serving,
+    "tp_serving": bench_tp_serving,
     "vit_huge_lamb": bench_vit_huge_lamb,
     "long_context": bench_long_context,
     "group_norm": bench_group_norm,
@@ -3447,6 +3634,13 @@ def _run_all():
             env = {"JAX_PLATFORMS": "cpu",
                    "PALLAS_AXON_POOL_IPS": None,
                    "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                                 + " --xla_force_host_platform_device"
+                                   "_count=8").strip()}
+        elif name == "tp_serving":
+            # needs a multi-chip mesh: the host-platform device-count
+            # flag makes the CPU smoke multi-device and is inert on a
+            # real TPU child (which brings its own chips)
+            env = {"XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
                                  + " --xla_force_host_platform_device"
                                    "_count=8").strip()}
         print(f"== {name}", file=sys.stderr)

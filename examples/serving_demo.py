@@ -21,10 +21,19 @@ With ``--kv-dtype int8`` (or ``fp8`` where the jax build has
 KV pool: 1-byte pages + per-page amax scales, ~2–4× the token capacity
 at equal HBM admitted as occupancy (docs/serving.md).
 
-Run (CPU works):
+With ``--tp M`` (M > 1) each replica spans M chips (tensor-parallel
+paged serving, docs/serving.md): the KV pool shards on kv_heads, the
+matmuls ride the GSPMD TP layers, and everything above — sharing,
+drafting, quantized pages, the fleet router — is unchanged.  Composes
+with ``--replicas N`` into an N×M fleet, each replica on its own
+device slice.
+
+Run (CPU works; --tp needs
+XLA_FLAGS=--xla_force_host_platform_device_count=8 on CPU):
     python examples/serving_demo.py [--max-slots 2] [--requests 5]
     python examples/serving_demo.py --replicas 3 --requests 8
     python examples/serving_demo.py --kv-dtype int8 --requests 5
+    python examples/serving_demo.py --tp 2 --replicas 2 --requests 6
 """
 
 from __future__ import annotations
@@ -47,13 +56,19 @@ def main():
                     help="quantize the paged KV pool (1-byte pages + "
                          "per-page amax scales; implies the paged "
                          "datapath on the single-server run)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="chips per replica (M > 1 = tensor-parallel "
+                         "paged serving: the KV pool shards on "
+                         "kv_heads, one replica spans M chips; "
+                         "implies the paged datapath and composes "
+                         "with --replicas into an NxM fleet)")
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
 
     from apex_tpu.models import GPTConfig, GPTModel
-    from apex_tpu.serving import FleetRouter, InferenceServer
+    from apex_tpu.serving import FleetRouter, InferenceServer, tp_mesh
     from apex_tpu.utils import MetricsWriter
 
     cfg = GPTConfig.tiny(position_embedding="learned",
@@ -98,13 +113,36 @@ def main():
             print(f"req {i} prompt={prompt.tolist()} -> {toks}")
         return handles
 
+    if args.tp < 1:
+        raise SystemExit(f"--tp must be >= 1, got {args.tp}")
+    devices = jax.devices()
+    if args.tp > len(devices):
+        raise SystemExit(
+            f"--tp {args.tp} needs {args.tp} devices, found "
+            f"{len(devices)} (on CPU run with XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8)")
+
     if args.replicas > 1:
+        import itertools
+
+        replica_idx = itertools.count()
+
         def factory():
+            mesh = None
+            if args.tp > 1:
+                # each replica gets its own tp-wide device slice
+                # (wrapping when the fleet overcommits the host —
+                # fine on CPU smoke, a real pod sizes N*M to fit)
+                off = next(replica_idx) * args.tp
+                mesh = tp_mesh(args.tp, [
+                    devices[(off + j) % len(devices)]
+                    for j in range(args.tp)])
             return InferenceServer(
                 model, params, max_slots=args.max_slots,
                 kv_cache="paged", block_size=8, prefill_chunk=4,
                 pool_tokens=args.max_slots * cfg.max_seq_len,
-                kv_dtype=args.kv_dtype, metrics_interval=4)
+                kv_dtype=args.kv_dtype, mesh=mesh,
+                metrics_interval=4)
 
         router = FleetRouter(factory, replicas=args.replicas,
                              probe_interval=0.1, metrics=metrics,
@@ -115,20 +153,23 @@ def main():
             health = router.health()
             print(f"fleet: replicas={args.replicas} "
                   f"ready={health['replicas_ready']} "
+                  f"chips_per_replica={health['chips_per_replica']} "
+                  f"chips_total={health['chips_total']} "
                   f"migrated={stats['migrated']}")
         print(f"done: {len(handles)} requests, "
               f"{stats['tokens_total']} tokens across "
-              f"{args.replicas} replicas")
+              f"{args.replicas} replicas x "
+              f"{health['chips_per_replica']} chips")
         return
 
-    if args.kv_dtype is not None:
-        # quantized pools live in the paged datapath (a dense server
-        # rejects kv_dtype loudly)
+    if args.kv_dtype is not None or args.tp > 1:
+        # quantized pools and tensor-parallel replicas live in the
+        # paged datapath (a dense server rejects both loudly)
         server = InferenceServer(
             model, params, max_slots=args.max_slots,
             kv_cache="paged", block_size=8, prefill_chunk=4,
-            kv_dtype=args.kv_dtype, metrics=metrics,
-            metrics_interval=4)
+            kv_dtype=args.kv_dtype, tp=args.tp if args.tp > 1 else 0,
+            metrics=metrics, metrics_interval=4)
     else:
         server = InferenceServer(
             model, params, max_slots=args.max_slots,
@@ -140,6 +181,10 @@ def main():
             h = server.health()
             print(f"kv: dtype={h['kv_dtype']} bits={h['kv_bits']} "
                   f"pool_tokens={server.engine.pool_tokens}")
+        if args.tp > 1:
+            h = server.health()
+            print(f"tp: chips_per_replica={h['chips_per_replica']} "
+                  f"mesh_shape={h['mesh_shape']}")
     print(f"done: {len(handles)} requests, "
           f"{server.tokens_emitted} tokens in {server.steps} steps")
 

@@ -23,6 +23,13 @@ Two acceptance soaks for the resilience layer (docs/resilience.md):
 - **quantized paged soak** (ISSUE 8): the sharing+spec paged soak
   with ``kv_dtype="int8"`` — zero lost/hung, ``blocks_in_use == 0``
   (per-page scales freed with their pages), budgets exactly 5 × 1.
+- **sharded-replica kill soak** (ISSUE 13): the fleet soak with a
+  TENSOR-PARALLEL replica in the pool (one replica spanning 2 chips,
+  KV pool sharded on kv_heads) — the TP replica is the one killed
+  under mixed traffic: zero lost/hung, its tenants migrate onto
+  single-chip survivors token-identically (migration re-prefills from
+  the streamed prefix, so replicas of DIFFERENT mesh shapes
+  interoperate), survivors' pools drain to ``blocks_in_use == 0``.
 - **ZeRO-sharded kill-and-resume** (ISSUE 11): the training soak with
   optimizer state ZeRO-2-sharded over the 8-device mesh — checkpoint
   mid-run, kill, restore onto the ``zero_shardings`` placement,
@@ -75,7 +82,12 @@ from apex_tpu.resilience import (
     ResilientLoop,
     active,
 )
-from apex_tpu.serving import FleetRouter, InferenceServer, RequestFailed
+from apex_tpu.serving import (
+    FleetRouter,
+    InferenceServer,
+    RequestFailed,
+    tp_mesh,
+)
 from apex_tpu.transformer.testing import standalone_gpt
 from apex_tpu.utils import MetricsWriter, lockcheck, numcheck, tracecheck
 
@@ -1058,4 +1070,126 @@ class TestFleetChaosSoak:
                 assert rep.server.engine.trace_counts \
                     == self.PAGED_BUDGET
         # drain + scale-up ran under the strict lock sanitizer too
+        lockcheck.assert_clean()
+
+
+class TestTPFleetChaosSoak:
+    """ISSUE-13 acceptance: a fleet with a TENSOR-PARALLEL replica in
+    the pool (replica spanning 2 chips, KV pool sharded on kv_heads)
+    survives a SIGKILL-equivalent death of exactly that replica under
+    mixed greedy/sampled/deadline traffic — zero lost/hung requests,
+    its tenants migrate onto the single-chip survivors with greedy
+    output token-identical to uninterrupted ``generate()`` (mesh
+    shapes are a per-replica detail: migration re-prefills from the
+    streamed prefix, so heterogeneous layouts interoperate), and the
+    survivors' pools drain to ``blocks_in_use == 0`` at the exact
+    4×1 budget."""
+
+    PAGED_BUDGET = {"decode_step": 1, "prefill_step": 1, "admit": 1,
+                    "release": 1}
+
+    def test_tp_replica_kill_zero_loss_token_identical(self):
+        cfg = GPTConfig.tiny(position_embedding="learned",
+                             scan_layers=True)
+        model = GPTModel(cfg)
+        params = {"params": model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 4), jnp.int32))["params"]}
+        vocab = cfg.vocab_size
+        import itertools
+
+        built = itertools.count()
+
+        def factory():
+            # the FIRST replica spans 2 chips; later builds (and any
+            # autoscale replacement) are single-chip — a mixed-layout
+            # fleet is the realistic mid-migration state
+            i = next(built)
+            mesh = tp_mesh(2, jax.devices()[:2]) if i == 0 else None
+            return lockcheck.instrument(InferenceServer(
+                model, params, max_slots=2, kv_cache="paged",
+                block_size=8, pool_tokens=256, prefill_chunk=4,
+                mesh=mesh), strict=True)
+
+        router = FleetRouter(factory, replicas=3, probe_interval=0.05)
+        lockcheck.reset()
+        lockcheck.instrument(router, strict=True)
+        rng = np.random.default_rng(41)
+        # budgets long enough that NOTHING completes before the kill
+        # lands — the TP replica must lose live mid-stream tenants,
+        # or the migration assertion below is vacuous
+        greedy_cases = [(4, 28), (7, 26), (3, 30), (6, 27), (9, 25),
+                        (2, 29)]
+        with router:
+            # the TP replica is identifiable by its chips gauge — and
+            # the fleet health must already merge it
+            merged = router.health()
+            assert merged["chips_per_replica"] == 2
+            assert merged["chips_total"] == 4         # 2 + 1 + 1
+            tp_index = next(
+                r.index for r in router._replicas
+                if r is not None and not r.dead
+                and r.server.health()["chips_per_replica"] == 2)
+            before = tracecheck.trace_event_count()
+            greedy = []
+            for i, (L, n) in enumerate(greedy_cases):
+                p = rng.integers(0, vocab, size=(L,)).astype(np.int32)
+                greedy.append((p, n, router.submit(
+                    p, max_new_tokens=n, seed=i)))
+            sampled = [router.submit(
+                rng.integers(0, vocab, size=(6,)).astype(np.int32),
+                max_new_tokens=18, temperature=0.9, top_p=0.9,
+                seed=100 + i) for i in range(2)]
+            doomed = [router.submit(np.zeros(3, np.int32),
+                                    max_new_tokens=5, deadline=1e-4)]
+            # midpoint: streams live AND the TP replica is actually
+            # serving someone (the kill must cost it tenants)
+            deadline = time.monotonic() + 180.0
+            while time.monotonic() < deadline:
+                live = all(len(h.tokens_so_far) >= 2
+                           for _, _, h in greedy)
+                if live and router._replicas[tp_index].active:
+                    break
+                time.sleep(0.01)
+            assert router._replicas[tp_index].active, \
+                "TP replica never took traffic — kill would be vacuous"
+            router.kill_replica(tp_index)
+
+            completed, failed, hung = 0, 0, 0
+            for h in ([h for _, _, h in greedy] + sampled + doomed):
+                try:
+                    toks = h.result(timeout=300)
+                    completed += 1
+                    assert len(toks) >= 1
+                except RequestFailed:
+                    failed += 1
+                except TimeoutError:
+                    hung += 1
+            stats = router.stats()
+            after = tracecheck.trace_event_count()
+            survivors = [r for r in router._replicas
+                         if r.index != tp_index]
+            for rep in survivors:
+                assert rep.server.engine.blocks_in_use == 0, rep.index
+                assert rep.server.engine.trace_counts \
+                    == self.PAGED_BUDGET, rep.index
+                assert rep.server.engine.chips_per_replica == 1
+
+        total = len(greedy) + len(sampled) + len(doomed)
+        assert hung == 0
+        assert completed + failed == total
+        assert completed == len(greedy) + len(sampled)
+        assert failed == len(doomed)
+        # the TP replica's death forced real migrations — and the
+        # clients never noticed: greedy chains == generate()
+        assert stats["migrated"] >= 1
+        for p, n, h in greedy:
+            ref = np.asarray(generate(
+                model, params, jnp.asarray(p[None]),
+                max_new_tokens=n))[0, len(p):]
+            np.testing.assert_array_equal(
+                np.asarray(h.result(timeout=1)), ref,
+                err_msg=f"migrated greedy stream diverged "
+                        f"(L={len(p)})")
+        assert after == before, "TP fleet kill soak retraced"
         lockcheck.assert_clean()

@@ -198,6 +198,34 @@ class TestServingDemoExample:
         assert "done: 5 requests" in r.stdout, r.stdout[-2000:]
 
     @pytest.mark.slow
+    def test_tp_path_serves_sharded_replica(self):
+        # [slow: a serving subprocess warming the tensor-parallel
+        # paged server ≈ 30s; the sharded datapath itself is
+        # tier-1-covered by test_tp_serving.py]
+        r = _run_example("examples/serving_demo.py",
+                         ["--requests", "4", "--max-slots", "2",
+                          "--tp", "2"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert r.stdout.count("req ") == 4, r.stdout[-2000:]
+        assert "tp: chips_per_replica=2" in r.stdout, r.stdout[-2000:]
+        assert "done: 4 requests" in r.stdout, r.stdout[-2000:]
+        assert "chips_per_replica=2" in r.stdout, r.stdout[-2000:]
+
+    @pytest.mark.slow
+    def test_tp_composes_with_replicas_fleet(self):
+        # [slow: a serving subprocess warming a 2×2 fleet (2 replicas
+        # × 2 chips, each on its own device slice) ≈ 60s; the merged
+        # chips gauges are tier-1-covered by test_tp_serving.py]
+        r = _run_example("examples/serving_demo.py",
+                         ["--requests", "4", "--max-slots", "2",
+                          "--tp", "2", "--replicas", "2"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert r.stdout.count("req ") == 4, r.stdout[-2000:]
+        assert "fleet: replicas=2 ready=2 chips_per_replica=2 " \
+               "chips_total=4" in r.stdout, r.stdout[-2000:]
+        assert "done: 4 requests" in r.stdout, r.stdout[-2000:]
+
+    @pytest.mark.slow
     def test_replicas_path_routes_through_fleet(self):
         # [slow: a second serving subprocess warming 2 paged replicas
         # ≈ 25s; the fleet router itself is tier-1-covered by

@@ -54,7 +54,8 @@ class FakeServer:
     the real tap plumbing."""
 
     def __init__(self, *, blocks=(0, 16), queue_depth=0, occupancy=0.0,
-                 reject=None, prefix_hit=0, kv_dtype=None, kv_bits=None):
+                 reject=None, prefix_hit=0, kv_dtype=None, kv_bits=None,
+                 chips=1, mesh_shape=None):
         self.calls = []
         self.live = {}                  # key -> (prompt, kwargs, tap)
         self._keys = itertools.count()
@@ -65,6 +66,8 @@ class FakeServer:
         self.prefix_hit = prefix_hit    # scripted trie hit (affinity)
         self.kv_dtype = kv_dtype        # scripted pool storage dtype
         self.kv_bits = kv_bits          # ... and width (None = dense)
+        self.chips = chips              # scripted chips_per_replica
+        self.mesh_shape = mesh_shape    # scripted TP mesh shape
         self.running = False
         self.draining = False
         self.metrics = None
@@ -96,6 +99,9 @@ class FakeServer:
         if self.kv_bits is not None:
             out["kv_dtype"] = self.kv_dtype
             out["kv_bits"] = self.kv_bits
+        out["chips_per_replica"] = self.chips
+        if self.mesh_shape:
+            out["mesh_shape"] = self.mesh_shape
         return out
 
     def latency_summary(self):
@@ -656,6 +662,36 @@ class TestFleetHealth:
         for _, m in rows:
             merged.update(m)
         assert merged.get("fleet/kv_bits_min") == 8.0
+        router.shutdown(wait=False)
+
+    def test_chips_merged_view(self):
+        """ISSUE-13 fleet view: a replica is no longer one chip — the
+        merged health() carries the widest replica
+        (``chips_per_replica``), the fleet's total chip count
+        (``chips_total`` = N×M capacity math), and the distinct
+        per-replica mesh shapes; the metrics row mirrors the numeric
+        two.  Health gauges stay per-replica, so routing and breakers
+        never changed."""
+        from apex_tpu.utils import MetricsWriter
+
+        a = FakeServer(blocks=(0, 16), chips=2,
+                       mesh_shape={"tensor": 2})
+        b = FakeServer(blocks=(0, 16), chips=2,
+                       mesh_shape={"tensor": 2})
+        c = FakeServer(blocks=(0, 16))       # single-chip replica
+        rows = []
+        writer = MetricsWriter(sink=lambda s, m: rows.append((s, m)))
+        router = _router([a, b, c], metrics=writer)
+        health = router.health()
+        assert health["chips_per_replica"] == 2
+        assert health["chips_total"] == 5
+        assert health["mesh_shapes"] == ["{'tensor': 2}"]
+        router._emit_metrics()
+        merged = {}
+        for _, m in rows:
+            merged.update(m)
+        assert merged.get("fleet/chips_per_replica") == 2.0
+        assert merged.get("fleet/chips_total") == 5.0
         router.shutdown(wait=False)
 
 

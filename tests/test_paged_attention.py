@@ -486,7 +486,16 @@ class TestAutotune:
             autotune.clear_cache()     # force a reload from the file
             assert autotune.cached_block_rows(
                 "paged_attention", 16,
-                str(jnp.dtype("float32"))) == best
+                str(jnp.dtype("float32")), kv_heads=2) == best
+            # entries are kv-head-qualified (ISSUE 13): a TP engine
+            # querying with its per-shard count must NOT find the
+            # full-head-count winner
+            assert autotune.cached_block_rows(
+                "paged_attention", 16,
+                str(jnp.dtype("float32")), kv_heads=1) is None
+            assert autotune.cached_block_rows(
+                "paged_attention", 16,
+                str(jnp.dtype("float32"))) is None
         finally:
             autotune.clear_cache()     # drop the tmp-file cache state
 
@@ -511,12 +520,18 @@ class TestAutotune:
             assert bs in (8, 16) and kvd in (None, "int8")
             autotune.clear_cache()
             assert autotune.cached_block_rows(
-                "paged_attention", 16, "float32") in (8, 16)
+                "paged_attention", 16, "float32", kv_heads=2) in (8, 16)
             assert autotune.cached_block_rows(
-                "paged_attention", 16, "int8") in (8, 16)
-            assert autotune.cached_paged_pair(16, "float32") == pair
-            # untuned (device, width, dtype) stays a miss
-            assert autotune.cached_paged_pair(32, "float32") is None
+                "paged_attention", 16, "int8", kv_heads=2) in (8, 16)
+            assert autotune.cached_paged_pair(
+                16, "float32", kv_heads=2) == pair
+            # untuned (device, width, dtype, kv_heads) stays a miss —
+            # incl. the same width at a different (per-shard) head
+            # count
+            assert autotune.cached_paged_pair(
+                32, "float32", kv_heads=2) is None
+            assert autotune.cached_paged_pair(
+                16, "float32", kv_heads=1) is None
         finally:
             autotune.clear_cache()
 

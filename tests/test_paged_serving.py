@@ -1173,7 +1173,8 @@ class TestQuantizedKV:
             autotune._store(
                 autotune._key("paged_attention_pair",
                               int(model.cfg.head_dim),
-                              str(jnp.dtype(model.cfg.dtype))),
+                              str(jnp.dtype(model.cfg.dtype)),
+                              kv_heads=int(model.cfg.kv_heads)),
                 [8, "int8"])
             warm = PagedEngine(model, params, max_slots=1,
                                block_size=0, kv_dtype="auto")

@@ -65,6 +65,13 @@ SUBSET = [
     # against REAL ICI collectives and per-device HBM — the virtual
     # CPU mesh proves the math, not the placement or the wire
     "tests/test_zero.py",
+    # tensor-parallel paged serving (ISSUE 13): the shard_map'ed paged
+    # kernel (per-chip head slices, replicated block tables), the
+    # sharded pool/scale placement fixed point behind the 5×1 retrace
+    # budgets, and the TP↔single-chip token identity must hold against
+    # REAL per-chip HBM pools and ICI all-reduces — the virtual CPU
+    # mesh proves the math, not the placement or the wire
+    "tests/test_tp_serving.py",
     "tests/test_chaos.py",
 ]
 
