@@ -32,7 +32,9 @@ from apex_tpu.ops.layer_norm import fused_layer_norm, fused_rms_norm
 from apex_tpu.ops.paged_attention import (
     kv_quant_spec,
     paged_attention,
+    paged_decode_fused,
     quantize_kv,
+    rope_rows as _rope_rows,
     tp_head_shards,
 )
 from apex_tpu.ops.mlp import resolve_activation
@@ -427,24 +429,6 @@ def _tp_pin(x, mesh, axis, dim):
             mesh, jax.sharding.PartitionSpec(*spec)))
 
 
-def _rope_rows(x, cos_b, sin_b):
-    """Half-rotation RoPE with PER-ROW position tables.
-
-    ``x`` (b, s, heads, d); ``cos_b``/``sin_b`` (b, s, 1, rot/2) —
-    gathered at each row's absolute positions.  The shared-table
-    :func:`~apex_tpu.ops.rope.fused_rope` broadcasts one (s, rot/2)
-    table over the batch, which cannot express a ragged batch of
-    tenants each at its own decode position (the paged serving path).
-    """
-    half = cos_b.shape[-1]
-    rot = 2 * half
-    x1 = x[..., :half].astype(jnp.float32)
-    x2 = x[..., half:rot].astype(jnp.float32)
-    o1 = (x1 * cos_b - x2 * sin_b).astype(x.dtype)
-    o2 = (x2 * cos_b + x1 * sin_b).astype(x.dtype)
-    return jnp.concatenate([o1, o2, x[..., rot:]], axis=-1)
-
-
 class ParallelAttention(nn.Module):
     """TP attention block: ColumnParallel qkv → RoPE → flash → RowParallel.
 
@@ -536,6 +520,7 @@ class ParallelAttention(nn.Module):
         cur = self.variable("cache", "cursors", jnp.zeros,
                             (b,), jnp.int32)
         positions = cur.value[:, None] + jnp.arange(s, dtype=jnp.int32)
+        cos_b = sin_b = None
         if cfg.position_embedding == "rope" and rot:
             # per-ROW rope: each tenant rotates at its own absolute
             # position (the shared-table fused_rope cannot express a
@@ -543,9 +528,39 @@ class ParallelAttention(nn.Module):
             # K/V are unreachable garbage either way
             cos, sin = rope_cos_sin(S, rot, base=cfg.rope_base)
             pc = jnp.minimum(positions, S - 1)
-            cb, sb = cos[pc][:, :, None, :], sin[pc][:, :, None, :]
-            q = _rope_rows(q, cb, sb)
-            k = _rope_rows(k, cb, sb)
+            cos_b = cos[pc][:, :, None, :]
+            sin_b = sin[pc][:, :, None, :]
+        if s == 1:
+            # FUSED decode prologue (ISSUE 14): the width-1 step —
+            # the serving engines' steady decode — routes RoPE, the
+            # (quantized) row write and the attend through ONE op:
+            # on TPU the Pallas kernel rotates/codes/writes the new
+            # row in-register on its way into the attend (pool
+            # aliased, only the write page moves); elsewhere the
+            # dispatch target is the historical unfused XLA sequence
+            # verbatim, so this branch is bitwise the old path there.
+            # Chunked prefill and the speculative verify (s > 1) keep
+            # the one-pass XLA scatter below.
+            outs = paged_decode_fused(
+                q, k, v, pk.value, pv.value, bt.value, cur.value,
+                max_seq_len=S, cos_b=cos_b, sin_b=sin_b,
+                scale=d ** -0.5,
+                k_scales=(ksc.value if store_dt is not None else None),
+                v_scales=(vsc.value if store_dt is not None else None),
+                chunk_lens=(cl.value if store_dt is not None else None),
+                mesh=cfg.kv_mesh, shard_axis=cfg.kv_shard_axis)
+            if store_dt is None:
+                o, kp_new, vp_new = outs
+            else:
+                o, kp_new, vp_new, ks_new, vs_new = outs
+                ksc.value = pin(ks_new, 0)
+                vsc.value = pin(vs_new, 0)
+            pk.value = pin(kp_new, 0)
+            pv.value = pin(vp_new, 0)
+            return o
+        if cos_b is not None:
+            q = _rope_rows(q, cos_b, sin_b)
+            k = _rope_rows(k, cos_b, sin_b)
         logical = jnp.minimum(positions // BS, MB - 1)
         phys = jnp.take_along_axis(bt.value, logical, axis=1)  # (b, s)
         # pad positions past max_seq_len go to the NULL page — the

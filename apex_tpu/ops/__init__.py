@@ -40,6 +40,10 @@ from apex_tpu.ops.paged_attention import (
     paged_attention,
     paged_attention_reference,
 )
+from apex_tpu.ops.fused_sampling import (
+    fused_sample,
+    fused_sample_reference,
+)
 from apex_tpu.ops.multihead_attn import SelfMultiheadAttn, EncdecMultiheadAttn
 
 __all__ = [
@@ -53,5 +57,6 @@ __all__ = [
     "batch_norm_train", "batch_norm_inference", "batch_norm_reference",
     "fused_attention", "attention_reference",
     "paged_attention", "paged_attention_reference",
+    "fused_sample", "fused_sample_reference",
     "SelfMultiheadAttn", "EncdecMultiheadAttn",
 ]

@@ -42,9 +42,10 @@ import pathlib
 import time
 from typing import Dict, Iterable, Optional
 
-__all__ = ["cached_block_rows", "cached_paged_pair", "tune_layer_norm",
+__all__ = ["cached_block_rows", "cached_paged_pair",
+           "cached_sampling_tile", "tune_layer_norm",
            "tune_softmax", "tune_batch_norm", "tune_paged_attention",
-           "clear_cache"]
+           "tune_fused_sampling", "clear_cache"]
 
 _CACHE: Optional[Dict[str, int]] = None
 
@@ -83,16 +84,24 @@ def _store(key: str, value: int) -> None:
         pass  # read-only FS: keep the in-memory entry
 
 
-def _key(op: str, width: int, dtype, kv_heads=None) -> str:
+def _key(op: str, width: int, dtype, kv_heads=None,
+         sample_w=None) -> str:
     """Cache key.  ``kv_heads`` (paged_attention only) qualifies the
     entry with the PER-SHARD kv-head count the sweep ran at: a
     tensor-parallel serving engine gathers ``kv_heads / tp`` heads'
     pages per chip, so its measured-best page size is a different
     quantity than the full-head-count winner — the two must never
-    alias (ISSUE 13 satellite)."""
+    alias (ISSUE 13 satellite).  ``sample_w`` (fused_sampling only)
+    qualifies the entry with the SAMPLE WIDTH the sweep ran at: the
+    decode step samples one position per row, the speculative verify
+    step ``1 + K`` — different row counts through the same vocab, so
+    their measured-best vocab tiles must never alias either (the same
+    per-key discipline, ISSUE 14 satellite)."""
     base = f"{_device_key()}/{op}/w{width}/{dtype}"
     if kv_heads is not None:
         base += f"/kvh{int(kv_heads)}"
+    if sample_w is not None:
+        base += f"/sw{int(sample_w)}"
     return base
 
 
@@ -121,6 +130,19 @@ def cached_paged_pair(width: int, dtype,
         return None
     bs, kvd = val
     return int(bs), (None if kvd in (None, "none") else str(kvd))
+
+
+def cached_sampling_tile(vocab: int, width: int) -> Optional[int]:
+    """Measured best vocab tile for the fused sampling kernel at
+    ``(vocab, width)``, or None if :func:`tune_fused_sampling` never
+    ran here.  ``width`` is the SAMPLE width (1 for the decode step,
+    ``1 + spec_tokens`` for the speculative verify step — separate
+    entries, like the paged per-shard keys).  The key dtype is pinned
+    ``float32``: the kernel's working set is its fp32 scratch
+    regardless of the logits dtype (the ``tune_batch_norm``
+    precedent)."""
+    return _load().get(_key("fused_sampling", int(vocab), "float32",
+                            sample_w=int(width)))
 
 
 def clear_cache() -> None:
@@ -377,6 +399,80 @@ def tune_paged_attention(n_rows: int = 8, width: int = 128,
     return best_pair
 
 
+def tune_fused_sampling(n_rows: int = 16, width: int = 32768,
+                        dtype="float32", sample_width: int = 1,
+                        candidates: Optional[Iterable[int]] = None,
+                        implementation: str = "pallas") -> Optional[int]:
+    """Sweep the fused sampling kernel's **vocab tile** at
+    ``(vocab=width, sample_width)``.
+
+    The tile sets the chunk the kernel's reduction passes sweep the
+    VMEM-resident row in (VPU granularity vs temporary pressure —
+    the radix descents re-read the row 64×, so the tile is the hot
+    loop's register-blocking knob).  ``width`` is the VOCAB here
+    (the shared ``--widths`` CLI flag names the row width of every
+    sweep); ``n_rows`` the decode batch (slots × sample width rows
+    reach the kernel); ``sample_width`` the per-row positions (1 =
+    decode step, ``1 + spec_tokens`` = the speculative verify step —
+    a SEPARATE cache entry, the per-key discipline of the paged
+    sweeps).  Candidates default to the 128-aligned divisors of the
+    vocab up to 8192 plus the whole row; non-divisors are skipped.
+
+    The winner lands under the key
+    :func:`cached_sampling_tile` reads and the serving engines adopt
+    via ``fused_sample(block_v=0)``.  ``implementation`` defaults to
+    the compiled kernel (sweeping anything else measures the wrong
+    artifact); tests exercise the cache mechanics with
+    ``"pallas_interpret"``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.ops.fused_sampling import (
+        fused_sample,
+        pallas_envelope_ok,
+    )
+
+    n_rows = max(1, min(int(n_rows), 256))
+    vocab = int(width)
+    if candidates is None:
+        candidates = [c for c in (128, 256, 512, 1024, 2048, 4096,
+                                  8192) if vocab % c == 0] + [vocab]
+    rng = np.random.default_rng(0)
+    shape = ((n_rows, vocab) if sample_width <= 1
+             else (n_rows, sample_width, vocab))
+    logits = jnp.asarray(rng.normal(size=shape), jnp.dtype(dtype))
+    keys = jnp.asarray(
+        rng.integers(0, 2**32, size=shape[:-1] + (2,), dtype=np.uint32))
+    temp = jnp.full((n_rows,), 0.8, jnp.float32)
+    topk = jnp.full((n_rows,), 40, jnp.int32)
+    topp = jnp.full((n_rows,), 0.9, jnp.float32)
+
+    rows_flat = n_rows * max(1, int(sample_width))
+
+    def build(bv):
+        if not pallas_envelope_ok(rows_flat, vocab, jnp.dtype(dtype),
+                                  bv):
+            # outside the kernel envelope fused_sample would silently
+            # dispatch to the XLA reference — timing THAT would cache
+            # a meaningless "measured" tile (the wrong-artifact trap
+            # the docstring warns about); skip the candidate instead
+            raise ValueError(
+                f"vocab tile {bv} outside the kernel envelope at "
+                f"vocab={vocab}")
+        fn = jax.jit(lambda l: fused_sample(
+            l, keys, temp, topk, topp, implementation=implementation,
+            block_v=bv))
+        return fn, (logits,)
+
+    best, _ = _best_candidate(build, candidates)
+    if best is not None:
+        _store(_key("fused_sampling", vocab, "float32",
+                    sample_w=int(sample_width)), best)
+    return best
+
+
 def main(argv=None):
     import argparse
 
@@ -389,24 +485,37 @@ def main(argv=None):
                         "sweep (and its cache keys) run at — for a "
                         "tensor-parallel deployment pass the model's "
                         "kv_heads // tp, what ONE chip serves")
+    p.add_argument("--sample-width", type=int, default=1,
+                   help="fused_sampling only: positions sampled per "
+                        "row (1 = decode step, 1 + spec_tokens = the "
+                        "speculative verify step) — each width is its "
+                        "own cache entry; --widths is the VOCAB for "
+                        "this op")
     p.add_argument("--ops", nargs="+", default=["layer_norm", "softmax"],
                    choices=["layer_norm", "softmax", "batch_norm",
-                            "paged_attention"])
+                            "paged_attention", "fused_sampling"])
     args = p.parse_args(argv)
     for width in args.widths:
         for op in args.ops:
             tune = {"layer_norm": tune_layer_norm,
                     "softmax": tune_softmax,
                     "batch_norm": tune_batch_norm,
-                    "paged_attention": tune_paged_attention}[op]
+                    "paged_attention": tune_paged_attention,
+                    "fused_sampling": tune_fused_sampling}[op]
             kw = ({"kv_heads": args.kv_heads}
                   if op == "paged_attention" else {})
+            if op == "fused_sampling":
+                kw = {"sample_width": args.sample_width}
             best = tune(n_rows=args.rows, width=width,
                         dtype=args.dtype, **kw)
             if op == "paged_attention":
                 bs, kvd = best if best else (None, None)
                 print(f"{op} w={width}: best block_size={bs} "
                       f"kv_dtype={kvd or 'none'} "
+                      f"(cache: {_cache_path()})")
+            elif op == "fused_sampling":
+                print(f"{op} vocab={width} sw={args.sample_width}: "
+                      f"best vocab tile={best} "
                       f"(cache: {_cache_path()})")
             else:
                 print(f"{op} w={width}: best block_rows={best} "

@@ -125,8 +125,9 @@ from jax.experimental.pallas import tpu as pltpu
 from apex_tpu.ops._dispatch import resolve_impl
 
 __all__ = ["paged_attention", "paged_attention_reference",
-           "kv_quant_spec", "kv_store_bytes_per_token", "quantize_kv",
-           "quantize_kv_pages", "tp_head_shards"]
+           "paged_decode_fused", "paged_decode_fused_reference",
+           "rope_rows", "kv_quant_spec", "kv_store_bytes_per_token",
+           "quantize_kv", "quantize_kv_pages", "tp_head_shards"]
 
 _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634
@@ -522,6 +523,559 @@ def _run_paged(q4, k_pages, v_pages, tables, lengths, scale, interpret,
     )(*args)
     return (o3.reshape(b, hk, rep, s, d)
             .transpose(0, 3, 1, 2, 4).reshape(b, s, h, d))
+
+
+def rope_rows(x, cos_b, sin_b):
+    """Half-rotation RoPE with PER-ROW position tables.
+
+    ``x`` (b, s, heads, d); ``cos_b``/``sin_b`` (b, s, 1, rot/2) —
+    gathered at each row's absolute positions.  The shared-table
+    :func:`~apex_tpu.ops.rope.fused_rope` broadcasts one (s, rot/2)
+    table over the batch, which cannot express a ragged batch of
+    tenants each at its own decode position (the paged serving path;
+    ``models/transformer.py`` routes both its chunk path and — through
+    :func:`paged_decode_fused` — its decode prologue here).
+    """
+    half = cos_b.shape[-1]
+    rot = 2 * half
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:rot].astype(jnp.float32)
+    o1 = (x1 * cos_b - x2 * sin_b).astype(x.dtype)
+    o2 = (x2 * cos_b + x1 * sin_b).astype(x.dtype)
+    return jnp.concatenate([o1, o2, x[..., rot:]], axis=-1)
+
+
+# --------------------------------------------------------------------- #
+# fused decode prologue — RoPE + (quantize +) page write + attend
+# --------------------------------------------------------------------- #
+def paged_decode_fused_reference(q, k_new, v_new, k_pages, v_pages,
+                                 block_tables, lengths, *,
+                                 max_seq_len: int,
+                                 cos_b=None, sin_b=None,
+                                 scale: Optional[float] = None,
+                                 k_scales=None, v_scales=None,
+                                 chunk_lens=None):
+    """The unfused decode-step prologue + attend, verbatim — golden
+    semantics of :func:`paged_decode_fused` and its CPU/GPU dispatch
+    target.
+
+    This is exactly the XLA op sequence ``models/transformer.py``'s
+    ``_paged_decode`` historically ran per step at chunk width 1:
+    per-row RoPE of ``q``/``k_new`` at each row's absolute position
+    (``cos_b``/``sin_b`` are the gathered per-row tables; ``None``
+    for non-rotary models), the new row's pool scatter at
+    ``lengths[b]`` (positions past ``max_seq_len`` route to the null
+    page), and the block-table-gathered attend.  With
+    ``k_scales``/``v_scales`` the write quantizes under the PR-8
+    monotone per-page running-amax discipline — reset at offset 0,
+    each row's amax chained through its previous page's scale, pad
+    lanes (``chunk_lens <= 0``) routed to the null page — specialized
+    to width 1 (the chunk ``cummax`` degenerates to the row amax).
+    Returns ``(o, k_pages, v_pages)`` plus ``(k_scales, v_scales)``
+    when quantized.
+    """
+    b, s, h, d = q.shape
+    if s != 1:
+        raise ValueError(
+            f"paged_decode_fused is the WIDTH-1 decode fusion (chunk "
+            f"and verify steps keep the one-pass XLA scatter), got "
+            f"s={s}")
+    hk, NB, BS, _ = k_pages.shape
+    MB = block_tables.shape[1]
+    S = int(max_seq_len)
+    scale = (d ** -0.5) if scale is None else scale
+    if cos_b is not None:
+        q = rope_rows(q, cos_b, sin_b)
+        k_new = rope_rows(k_new, cos_b, sin_b)
+    positions = lengths[:, None]                        # (b, 1)
+    logical = jnp.minimum(positions // BS, MB - 1)
+    phys = jnp.take_along_axis(block_tables, logical, axis=1)
+    phys = jnp.where(positions < S, phys, 0)
+    off = positions % BS
+    kT = k_new.transpose(2, 0, 1, 3)                    # (hk, b, 1, d)
+    vT = v_new.transpose(2, 0, 1, 3)
+    if k_scales is None:
+        kp = k_pages.at[:, phys, off].set(kT)
+        vp = v_pages.at[:, phys, off].set(vT)
+        o = paged_attention_reference(q, kp, vp, block_tables,
+                                      lengths, scale=scale)
+        return o, kp, vp
+    qmax = _qmax_for_pool(k_pages.dtype)
+    store_dt = k_pages.dtype
+    cl = (jnp.full((b,), S, jnp.int32) if chunk_lens is None
+          else chunk_lens)
+    real = (jnp.zeros((b, 1), jnp.int32)
+            < cl[:, None])                              # (b, 1)
+    phys = jnp.where(real, phys, 0)
+    ka = jnp.max(jnp.abs(kT.astype(jnp.float32)), axis=-1)
+    va = jnp.max(jnp.abs(vT.astype(jnp.float32)), axis=-1)
+    ka = jnp.where(real[None], ka, 0.0)                 # (hk, b, 1)
+    va = jnp.where(real[None], va, 0.0)
+    base_logical = jnp.clip((lengths - 1) // BS, 0, MB - 1)
+    base_phys = jnp.take_along_axis(
+        block_tables, base_logical[:, None], axis=1)[:, 0]
+    has_prefix = lengths > 0
+    k_base = jnp.where(has_prefix[None, :],
+                       k_scales[:, base_phys], 0.0)     # (hk, b)
+    v_base = jnp.where(has_prefix[None, :],
+                       v_scales[:, base_phys], 0.0)
+    k_run = jnp.maximum(jax.lax.cummax(ka, axis=2),
+                        k_base[:, :, None])
+    v_run = jnp.maximum(jax.lax.cummax(va, axis=2),
+                        v_base[:, :, None])
+    fresh = jnp.where(off == 0, phys, 0)
+    ks_new = k_scales.at[:, fresh].set(0.0).at[:, phys].max(k_run)
+    vs_new = v_scales.at[:, fresh].set(0.0).at[:, phys].max(v_run)
+    kp = k_pages.at[:, phys, off].set(
+        quantize_kv(kT, ks_new[:, phys], qmax, store_dt))
+    vp = v_pages.at[:, phys, off].set(
+        quantize_kv(vT, vs_new[:, phys], qmax, store_dt))
+    o = paged_attention_reference(q, kp, vp, block_tables, lengths,
+                                  scale=scale, k_scales=ks_new,
+                                  v_scales=vs_new)
+    return o, kp, vp, ks_new, vs_new
+
+
+def _paged_fused_kernel(tables_ref, lens_ref, wphys_ref, woff_ref,
+                        base_ref, real_ref, q_ref, k_ref, v_ref,
+                        wk_ref, wv_ref, nk_ref, nv_ref, *refs,
+                        bs, rep, scale, nb, S, half, qmax=None):
+    """The decode sweep of :func:`_paged_kernel` (s = 1) with the
+    step's PROLOGUE folded in: at its first page visit each (row,
+    head) rotates the row's new K (RoPE at the row's absolute
+    position), quantizes it under the monotone running-amax discipline
+    when the pool is coded, and writes it — with its V — into the
+    row's WRITE PAGE tile, which lands back in the pool through the
+    aliased output instead of a separate XLA scatter pass.  The attend
+    then swaps the updated tile (and its updated scale) in when the
+    page sweep reaches the write page, so the new token is visible to
+    its own query (write-then-attend) without the pool round-trip.
+
+    Extra scalar prefetch vs the plain kernel: ``wphys``/``woff`` (the
+    write page and offset, null-routed on the host side of the trace),
+    ``base`` (the previous page — the scale chain's seed) and ``real``
+    (the pad-lane routing bit).  ``half`` is the RoPE half-rotation
+    width (0 = non-rotary model).  Outputs gain the write-page views
+    of the pool (and scales), each aliased to its input so untouched
+    pages persist.
+    """
+    if qmax is None:
+        cos_ref = sin_ref = ks_ref = vs_ref = None
+        wks_ref = wvs_ref = bks_ref = bvs_ref = None
+        rest = list(refs)
+        if half:
+            cos_ref, sin_ref = rest[:2]
+            rest = rest[2:]
+        (o_ref, kp_out, vp_out, m_ref, l_ref, acc_ref) = rest
+        ks_out = vs_out = None
+    else:
+        rest = list(refs)
+        cos_ref = sin_ref = None
+        if half:
+            cos_ref, sin_ref = rest[:2]
+            rest = rest[2:]
+        (ks_ref, vs_ref, wks_ref, wvs_ref, bks_ref, bvs_ref,
+         o_ref, kp_out, vp_out, ks_out, vs_out,
+         m_ref, l_ref, acc_ref) = rest
+    row = pl.program_id(0)
+    j = pl.program_id(2)
+
+    length = lens_ref[row]
+    woff = woff_ref[row]
+    real = real_ref[row] != 0
+    write_ok = (length < S) & real
+    wlog = length // bs                 # the write page IS the last
+    # live page of the sweep (s = 1)
+
+    def _rot_row(x_row, x1_cos, x1_sin):
+        # half-rotation RoPE of (rows, d) at this row's position —
+        # bitwise rope_rows (f32 math, cast back)
+        x1 = x_row[:, :half].astype(jnp.float32)
+        x2 = x_row[:, half:2 * half].astype(jnp.float32)
+        o1 = (x1 * x1_cos - x2 * x1_sin).astype(x_row.dtype)
+        o2 = (x2 * x1_cos + x1 * x1_sin).astype(x_row.dtype)
+        return jnp.concatenate([o1, o2, x_row[:, 2 * half:]], axis=-1)
+
+    if half:
+        cos_row = cos_ref[:].astype(jnp.float32)     # (1, half)
+        sin_row = sin_ref[:].astype(jnp.float32)
+        qt = _rot_row(q_ref[0, 0], cos_row, sin_row)
+        k_row = _rot_row(nk_ref[0], cos_row, sin_row)
+    else:
+        qt = q_ref[0, 0]
+        k_row = nk_ref[0]
+    v_row = nv_ref[0]                                # (1, d)
+
+    # the updated write tile (+ scales): computed at the first visit,
+    # persisted in the aliased out blocks (same index all sweep long)
+    @pl.when(j == 0)
+    def _prologue():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        if qmax is None:
+            kw = jnp.where(write_ok, k_row, wk_ref[0, 0][woff][None])
+            vw = jnp.where(write_ok, v_row, wv_ref[0, 0][woff][None])
+            kp_out[0, 0] = wk_ref[0, 0].at[woff].set(kw[0])
+            vp_out[0, 0] = wv_ref[0, 0].at[woff].set(vw[0])
+        else:
+            # monotone running-amax scale chain, width-1 form: the
+            # write page's new scale = max(row amax, previous scale)
+            # where "previous" is the prior page's scale at a fresh
+            # page (offset 0) and the page's own at an append —
+            # bitwise the reference's reset + scatter-max
+            ka = jnp.max(jnp.abs(k_row.astype(jnp.float32)))
+            va = jnp.max(jnp.abs(v_row.astype(jnp.float32)))
+            ka = jnp.where(real, ka, 0.0)
+            va = jnp.where(real, va, 0.0)
+            bk = jnp.where(length > 0, bks_ref[0, 0], 0.0)
+            bv = jnp.where(length > 0, bvs_ref[0, 0], 0.0)
+            cur_k = jnp.where(woff == 0, 0.0, wks_ref[0, 0])
+            cur_v = jnp.where(woff == 0, 0.0, wvs_ref[0, 0])
+            nks = jnp.maximum(cur_k, jnp.maximum(ka, bk))
+            nvs = jnp.maximum(cur_v, jnp.maximum(va, bv))
+
+            def _code(x_row, sc):
+                ok = sc > _TINY_SCALE
+                inv = jnp.where(
+                    ok, qmax / jnp.maximum(sc, _TINY_SCALE), 0.0)
+                y = jnp.clip(x_row.astype(jnp.float32) * inv,
+                             -qmax, qmax)
+                if jnp.issubdtype(jnp.dtype(k_ref.dtype),
+                                  jnp.integer):
+                    y = jnp.round(y)
+                return y.astype(k_ref.dtype)
+
+            kw = jnp.where(write_ok, _code(k_row, nks),
+                           wk_ref[0, 0][woff][None])
+            vw = jnp.where(write_ok, _code(v_row, nvs),
+                           wv_ref[0, 0][woff][None])
+            kp_out[0, 0] = wk_ref[0, 0].at[woff].set(kw[0])
+            vp_out[0, 0] = wv_ref[0, 0].at[woff].set(vw[0])
+            ks_out[0, 0] = jnp.where(write_ok, nks, wks_ref[0, 0])
+            vs_out[0, 0] = jnp.where(write_ok, nvs, wvs_ref[0, 0])
+
+    last_q = length                     # s == 1
+
+    def _step():
+        use_new = (j == wlog) & write_ok
+        qs = qt * jnp.asarray(scale * _LOG2E, qt.dtype)
+        kt = jnp.where(use_new, kp_out[0, 0], k_ref[0, 0])
+        kq = kt if qmax is None else kt.astype(qs.dtype)
+        sc = jax.lax.dot_general(
+            kq, qs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bs, rep)
+        if qmax is not None:
+            ksc = jnp.where(use_new, ks_out[0, 0], ks_ref[0, 0])
+            sc = sc * (ksc * jnp.float32(1.0 / qmax))
+        k_pos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (bs, rep), 0)
+        sc = jnp.where(k_pos > length, _NEG_INF, sc)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=0, keepdims=True))
+        p = jnp.exp2(sc - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=0, keepdims=True)
+        vt = jnp.where(use_new, vp_out[0, 0], v_ref[0, 0])
+        if qmax is None:
+            vq, pv = vt, p.astype(vt.dtype)
+        else:
+            vsc = jnp.where(use_new, vs_out[0, 0], vs_ref[0, 0])
+            vq = vt.astype(jnp.float32) * (vsc * jnp.float32(1.0 / qmax))
+            pv = p
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            vq, pv, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (d, rep)
+        m_ref[:] = m_new
+
+    pl.when(j * bs <= last_q)(_step)
+
+    @pl.when(j == nb - 1)
+    def _final():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = jnp.transpose(acc_ref[:] / l_safe).astype(
+            o_ref.dtype)
+
+
+def _run_decode_fused(q4, k_new, v_new, k_pages, v_pages, tables,
+                      lengths, S, cos_b, sin_b, scale, interpret,
+                      k_scales=None, v_scales=None, chunk_lens=None):
+    b, s, h, d = q4.shape
+    hk, _nb_pool, bs, _ = k_pages.shape
+    rep = h // hk
+    mb = tables.shape[1]
+    quantized = k_scales is not None
+    half = 0 if cos_b is None else int(cos_b.shape[-1])
+    q3 = (q4.reshape(b, 1, hk, rep, d)
+          .transpose(0, 2, 3, 1, 4).reshape(b, hk, rep, d))
+    nk = k_new.reshape(b, hk, d)
+    nv = v_new.reshape(b, hk, d)
+    # the write target, resolved once in-trace (the kernel's scalar
+    # prefetch): position -> clamped logical page -> physical, with
+    # past-the-cache and pad-lane writes routed to the null page
+    # exactly as the reference
+    positions = lengths
+    logical = jnp.minimum(positions // bs, mb - 1)
+    wphys = jnp.take_along_axis(tables, logical[:, None],
+                                axis=1)[:, 0]
+    wphys = jnp.where(positions < S, wphys, 0)
+    woff = positions % bs
+    real = (jnp.ones((b,), jnp.int32)
+            if chunk_lens is None
+            else (chunk_lens > 0).astype(jnp.int32))
+    wphys = jnp.where(real != 0, wphys, 0)
+    base_logical = jnp.clip((lengths - 1) // bs, 0, mb - 1)
+    base_phys = jnp.take_along_axis(tables, base_logical[:, None],
+                                    axis=1)[:, 0]
+
+    def _kv_map(row, head, j, *pref):
+        tables_ref, lens_ref = pref[0], pref[1]
+        live = jnp.maximum(lens_ref[row], 0) // bs
+        return head, tables_ref[row, jnp.minimum(j, live)], 0, 0
+
+    def _w_map(row, head, j, *pref):
+        return head, pref[2][row], 0, 0
+
+    def _scale_map(row, head, j, *pref):
+        tables_ref, lens_ref = pref[0], pref[1]
+        live = jnp.maximum(lens_ref[row], 0) // bs
+        return head, tables_ref[row, jnp.minimum(j, live)]
+
+    def _wscale_map(row, head, j, *pref):
+        return head, pref[2][row]
+
+    def _bscale_map(row, head, j, *pref):
+        return head, pref[4][row]
+
+    in_specs = [
+        pl.BlockSpec((1, 1, rep, d),
+                     lambda row, head, j, *_: (row, head, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), _kv_map),
+        pl.BlockSpec((1, 1, bs, d), _kv_map),
+        pl.BlockSpec((1, 1, bs, d), _w_map),
+        pl.BlockSpec((1, 1, bs, d), _w_map),
+        pl.BlockSpec((1, 1, d),
+                     lambda row, head, j, *_: (row, head, 0)),
+        pl.BlockSpec((1, 1, d),
+                     lambda row, head, j, *_: (row, head, 0)),
+    ]
+    args = [tables, lengths, wphys, woff, base_phys, real,
+            q3, k_pages, v_pages, k_pages, v_pages, nk, nv]
+    if half:
+        in_specs += [
+            pl.BlockSpec((1, half),
+                         lambda row, head, j, *_: (row, 0)),
+            pl.BlockSpec((1, half),
+                         lambda row, head, j, *_: (row, 0)),
+        ]
+        args += [cos_b.reshape(b, half).astype(jnp.float32),
+                 sin_b.reshape(b, half).astype(jnp.float32)]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1), _scale_map),
+            pl.BlockSpec((1, 1), _scale_map),
+            pl.BlockSpec((1, 1), _wscale_map),
+            pl.BlockSpec((1, 1), _wscale_map),
+            pl.BlockSpec((1, 1), _bscale_map),
+            pl.BlockSpec((1, 1), _bscale_map),
+        ]
+        ksf = k_scales.astype(jnp.float32)
+        vsf = v_scales.astype(jnp.float32)
+        args += [ksf, vsf, ksf, vsf, ksf, vsf]
+    out_specs = [
+        pl.BlockSpec((1, 1, rep, d),
+                     lambda row, head, j, *_: (row, head, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), _w_map),
+        pl.BlockSpec((1, 1, bs, d), _w_map),
+    ]
+    out_shapes = [
+        jax.ShapeDtypeStruct((b, hk, rep, d), q4.dtype),
+        jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+        jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+    ]
+    # inputs count scalar prefetch first: 6 scalars, then q3 (6),
+    # k_pages read view (7), v_pages (8) — aliased to pool outputs so
+    # unvisited pages persist
+    aliases = {7: 1, 8: 2}
+    if quantized:
+        out_specs += [pl.BlockSpec((1, 1), _wscale_map),
+                      pl.BlockSpec((1, 1), _wscale_map)]
+        out_shapes += [jax.ShapeDtypeStruct((hk, _nb_pool), jnp.float32),
+                       jax.ShapeDtypeStruct((hk, _nb_pool), jnp.float32)]
+        # scale read views sit after q3/pools/write-views/nk/nv (+rope)
+        ks_idx = 13 + (2 if half else 0)
+        aliases[ks_idx] = 3
+        aliases[ks_idx + 1] = 4
+    kernel = functools.partial(
+        _paged_fused_kernel, bs=bs, rep=rep, scale=scale, nb=mb,
+        S=S, half=half,
+        qmax=_qmax_for_pool(k_pages.dtype) if quantized else None)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(b, hk, mb),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((1, rep), jnp.float32),       # m
+            pltpu.VMEM((1, rep), jnp.float32),       # l
+            pltpu.VMEM((d, rep), jnp.float32),       # transposed acc
+        ],
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*args)
+    o3 = outs[0].reshape(b, hk, rep, 1, d) \
+        .transpose(0, 3, 1, 2, 4).reshape(b, 1, h, d)
+    if quantized:
+        return (o3, outs[1], outs[2], outs[3], outs[4])
+    return o3, outs[1], outs[2]
+
+
+def _run_decode_fused_sharded(q, k_new, v_new, k_pages, v_pages,
+                              tables, lengths, S, cos_b, sin_b, scale,
+                              implementation, k_scales, v_scales,
+                              chunk_lens, mesh, axis):
+    """shard_map wrapper for the fused decode step: pool, scales and
+    the new K/V rows shard on their kv_heads axes, q on its head axis,
+    everything host-authoritative replicated — the write is
+    shard-local (every chip scatters its own heads' row), so the TP
+    layout of PR 12 is preserved bitwise with no collective here."""
+    _b, _s, h, _d = q.shape
+    hk = k_pages.shape[0]
+    tp_head_shards(h, hk, mesh.shape[axis])
+    P = jax.sharding.PartitionSpec
+    q_spec = P(None, None, axis, None)
+    pool_spec = P(axis, None, None, None)
+    rep_spec = P()
+    # optional operands ride one dict pytree whose keys ARE the local
+    # call's kwargs — shard_map specs mirror the structure, and the
+    # body needs no per-case unpacking
+    opt, opt_specs = {}, {}
+    if cos_b is not None:
+        opt.update(cos_b=cos_b, sin_b=sin_b)
+        opt_specs.update(cos_b=rep_spec, sin_b=rep_spec)
+    quantized = k_scales is not None
+    if quantized:
+        opt.update(k_scales=k_scales, v_scales=v_scales)
+        opt_specs.update(k_scales=P(axis, None),
+                         v_scales=P(axis, None))
+    if chunk_lens is not None:
+        opt["chunk_lens"] = chunk_lens
+        opt_specs["chunk_lens"] = rep_spec
+    in_specs = (q_spec, q_spec, q_spec, pool_spec, pool_spec,
+                rep_spec, rep_spec, opt_specs)
+    out_specs = (q_spec, pool_spec, pool_spec)
+    if quantized:
+        out_specs += (P(axis, None), P(axis, None))
+
+    def local(q, nk, nv, kp, vp, bt, ln, opt):
+        return paged_decode_fused(
+            q, nk, nv, kp, vp, bt, ln, max_seq_len=S, scale=scale,
+            implementation=implementation, **opt)
+
+    return jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(
+        q, k_new, v_new, k_pages, v_pages, tables, lengths, opt)
+
+
+def paged_decode_fused(q, k_new, v_new, k_pages, v_pages, block_tables,
+                       lengths, *, max_seq_len: int, cos_b=None,
+                       sin_b=None, scale: Optional[float] = None,
+                       implementation: Optional[str] = None,
+                       k_scales=None, v_scales=None, chunk_lens=None,
+                       mesh=None, shard_axis: Optional[str] = None):
+    """One fused decode step over the paged pool: per-row RoPE of
+    ``q``/``k_new``, (quantized) write of the new K/V row into its
+    page, and the block-table-gathered attend — the attention
+    PROLOGUE that used to run as detached XLA passes
+    (``rope_rows → quantize_kv → pool scatter``) folded into the
+    Pallas kernel, so the row is rotated, coded and written
+    in-register on its way into the attend (ISSUE 14's second fusion
+    front).  Strictly the WIDTH-1 step: chunked prefill and the
+    speculative verify keep the one-pass XLA scatter (an in-kernel
+    multi-page scatter would re-DMA every page the chunk straddles
+    per (row, head) grid step).
+
+    ``q`` (b, 1, h, d) and ``k_new``/``v_new`` (b, 1, hk, d) arrive
+    UNROTATED; ``cos_b``/``sin_b`` (b, 1, 1, rot/2) are the per-row
+    RoPE tables gathered at ``lengths`` (``None`` for non-rotary
+    models).  Pool/table/length shapes as in the module docstring;
+    ``lengths[b]`` is both the mask horizon and the write position.
+    Quantized pools add ``k_scales``/``v_scales`` (updated copies are
+    returned) and ``chunk_lens`` (the engine's pad-lane routing leaf).
+    Returns ``(o, k_pages, v_pages[, k_scales, v_scales])`` — the
+    pool leaves updated with the written row, everything else
+    byte-preserved (the kernel aliases the pool, so only the write
+    page moves; the null page's contents stay garbage-by-contract on
+    every path).
+
+    With ``mesh``/``shard_axis`` the whole fused step runs
+    tensor-parallel exactly like :func:`paged_attention` — pool,
+    scales and the new rows shard on kv_heads, the write staying
+    shard-local, block tables replicated (bitwise the PR-12 layout).
+
+    Dispatch per :mod:`apex_tpu.ops._dispatch`;
+    :func:`paged_decode_fused_reference` is the golden anchor — the
+    historical unfused sequence verbatim — and the kernel is
+    bit-compatible with it up to the blocked-vs-einsum accumulation
+    order of the attend (the ``paged_attention`` contract), with
+    codes, scales and written pages bitwise identical on live pages.
+    """
+    b, s, h, d = q.shape
+    if s != 1:
+        raise ValueError(
+            f"paged_decode_fused handles the width-1 decode step "
+            f"only, got s={s}")
+    if k_new.shape != v_new.shape:
+        raise ValueError(
+            f"k_new/v_new shapes differ: {k_new.shape} vs "
+            f"{v_new.shape}")
+    hk, nb, bs, dk = k_pages.shape
+    if k_new.shape != (b, 1, hk, d):
+        raise ValueError(
+            f"k_new shape {k_new.shape} != (b, 1, kv_heads, d) = "
+            f"{(b, 1, hk, d)}")
+    if (cos_b is None) != (sin_b is None):
+        raise ValueError("cos_b and sin_b come together")
+    quantized = _is_quantized_pool(k_pages.dtype)
+    if quantized and (k_scales is None or v_scales is None):
+        raise ValueError(
+            f"quantized pages ({k_pages.dtype}) need k_scales AND "
+            "v_scales")
+    if not quantized and (k_scales is not None or chunk_lens is not None):
+        raise ValueError(
+            "k_scales/v_scales/chunk_lens only apply to quantized "
+            f"pools; pages are {k_pages.dtype}")
+    scale = (d ** -0.5) if scale is None else float(scale)
+    if shard_axis is not None and mesh is not None \
+            and mesh.shape.get(shard_axis, 1) > 1:
+        return _run_decode_fused_sharded(
+            q, k_new, v_new, k_pages, v_pages, block_tables, lengths,
+            int(max_seq_len), cos_b, sin_b, scale, implementation,
+            k_scales, v_scales, chunk_lens, mesh, shard_axis)
+    half = 0 if cos_b is None else int(cos_b.shape[-1])
+    pallas_ok = (bs % 8 == 0 and d % 8 == 0
+                 and (half == 0 or half % 8 == 0)
+                 and (quantized
+                      or q.dtype == k_pages.dtype == v_pages.dtype))
+    impl = resolve_impl(implementation, pallas_ok=pallas_ok)
+    if impl == "xla" or not pallas_ok:
+        return paged_decode_fused_reference(
+            q, k_new, v_new, k_pages, v_pages, block_tables, lengths,
+            max_seq_len=int(max_seq_len), cos_b=cos_b, sin_b=sin_b,
+            scale=scale, k_scales=k_scales, v_scales=v_scales,
+            chunk_lens=chunk_lens)
+    return _run_decode_fused(
+        q, k_new, v_new, k_pages, v_pages,
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(lengths, jnp.int32), int(max_seq_len), cos_b,
+        sin_b, scale, impl == "pallas_interpret",
+        k_scales=k_scales, v_scales=v_scales, chunk_lens=chunk_lens)
 
 
 # --------------------------------------------------------------------- #

@@ -8,7 +8,12 @@ executables for the engine's whole lifetime:
   sampling whose parameters (temperature / top_k / top_p / eos /
   budget) are device arrays in
   :class:`~apex_tpu.serving.cache.SlotState` — mixed sampling configs
-  (nucleus sampling included) share the executable.
+  (nucleus sampling included) share the executable.  The sampling
+  tail is the FUSED epilogue of :mod:`apex_tpu.ops.fused_sampling`
+  (ISSUE 14): one Pallas pass over the ``(slots, vocab)`` logits on
+  TPU, the sort-based reference elsewhere — token-identical either
+  way, and the reference now ``lax.cond``-skips its sort when no
+  admitted row enables top-k/top-p.
 - ``prefill``      — one trace PER PROMPT BUCKET: the prompt, right-
   padded to its bucket length, runs through the shared chunked-prefill
   path (``apex_tpu.models.generate.prefill_tokens``) into a fresh
@@ -55,6 +60,8 @@ from apex_tpu.models.generate import (
     cache_shapes,
     prefill_tokens,
 )
+from apex_tpu.ops.fused_sampling import fused_sample, \
+    fused_sample_reference
 from apex_tpu.ops.paged_attention import tp_head_shards
 from apex_tpu.serving import cache as slot_cache
 from apex_tpu.utils import tracecheck
@@ -204,48 +211,42 @@ def sample_dynamic(logits, keys, temperature, top_k, top_p,
                    vocab_size: int):
     """Branchless per-row sampling with DEVICE-ARRAY parameters.
 
-    ``logits`` (rows, vocab); ``keys`` (rows, 2) uint32; ``temperature``
-    / ``top_k`` / ``top_p`` (rows,).  Per row: fp32 argmax when
-    ``temperature <= 0`` else top-k- and/or nucleus-truncated
-    categorical at ``logits/temperature`` (``top_k == 0`` and
-    ``top_p <= 0`` / ``>= 1`` disable their filters — a disabled
-    filter is an exact no-op, not an epsilon approximation).  The math
-    mirrors ``generate``'s static
-    :func:`~apex_tpu.models.generate.sample_logits` — kth-largest /
-    nucleus threshold on the scaled logits, ``-1e30`` mask, top-k
-    before top-p (the HF warper order) — but every parameter is
-    traced, so one executable serves any mix.  The nucleus pass reuses
-    the top-k sort (the post-mask order is the pre-mask order with the
-    masked tail replaced), so mixed top-p traffic costs no second
-    O(V·logV) sort.
+    The engines' historical sampling tail, now living in
+    :func:`apex_tpu.ops.fused_sampling.fused_sample_reference` as the
+    golden semantics (and the non-Pallas dispatch target) of the fused
+    one-pass sampling kernel — this name stays as the reference entry
+    point and delegates verbatim.  Semantics: per row fp32 argmax when
+    ``temperature <= 0``, else top-k- and/or nucleus-truncated
+    categorical at ``logits/temperature``, mirroring ``generate``'s
+    static :func:`~apex_tpu.models.generate.sample_logits` with traced
+    parameters; an all-greedy / plain-temperature step now
+    ``lax.cond``-skips the whole sort + softmax + cumsum tail at
+    runtime (bitwise-equivalent on that predicate — see the ops
+    module).  The engines themselves call
+    :func:`~apex_tpu.ops.fused_sampling.fused_sample`, which resolves
+    to the one-pass Pallas kernel on TPU and to exactly this
+    composition elsewhere.
     """
-    logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = logits / safe_t
-    k = jnp.where(top_k > 0, top_k, vocab_size)          # (rows,)
-    ordered = jnp.sort(scaled, axis=-1)                  # ascending
-    kth = jnp.take_along_axis(
-        ordered, (vocab_size - k)[:, None], axis=-1)     # k-th largest
-    scaled = jnp.where(scaled < kth, -1e30, scaled)
-    # nucleus filter over the top-k-masked distribution, sort reused:
-    # descending masked order = reversed `ordered` with the SAME
-    # `< kth` criterion applied that masked `scaled` — value-based,
-    # not position-based, so k-th-boundary ties survive in both or
-    # neither (keeps engine/generate parity in tie cases)
-    p_on = (top_p > 0.0) & (top_p < 1.0)                 # (rows,)
-    rev = ordered[:, ::-1]
-    desc = jnp.where(rev < kth, -1e30, rev)
-    probs = jax.nn.softmax(desc, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = cum - probs < jnp.where(p_on, top_p, 1.0)[:, None]
-    thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
-                     keepdims=True)
-    scaled = jnp.where(p_on[:, None] & (scaled < thresh), -1e30,
-                       scaled)
-    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
-    sampled = sampled.astype(jnp.int32)
-    return jnp.where(temperature > 0.0, sampled, greedy)
+    return fused_sample_reference(logits, keys, temperature, top_k,
+                                  top_p, vocab_size)
+
+
+def _active_sampling_params(state):
+    """``(temperature, top_k, top_p)`` with RELEASED slots' filter
+    params neutralized.
+
+    ``release_slot`` only clears the active bit — a finished top-k /
+    top-p tenant would otherwise leave its stale filter params in the
+    slot row forever, and the fused epilogue's runtime sort
+    short-circuit (skip the sort + cumsum tail when NO row enables a
+    filter) would never fire again for the engine's lifetime.  Masking
+    by ``active`` only changes rows whose tokens the emission gates
+    already discard, so emitted chains are bit-identical either way —
+    but the short-circuit predicate sees the true live traffic.
+    """
+    return (state.temperature,
+            jnp.where(state.active, state.top_k, 0),
+            jnp.where(state.active, state.top_p, 0.0))
 
 
 class Engine:
@@ -341,9 +342,14 @@ class Engine:
 
             logits, pool = jax.vmap(one_slot)(pool, state.tok)
             split = jax.vmap(jax.random.split)(state.rng)
-            nxt = sample_dynamic(logits, split[:, 0],
-                                 state.temperature, state.top_k,
-                                 state.top_p, vocab)
+            # the fused decode epilogue: one-pass Pallas sampling on
+            # TPU, the sample_dynamic reference elsewhere — tokens
+            # identical either way (ops/fused_sampling parity
+            # contract); released slots' stale filter params are
+            # masked so the sort short-circuit tracks live traffic
+            temp, top_k, top_p = _active_sampling_params(state)
+            nxt = fused_sample(logits, split[:, 0], temp, top_k,
+                               top_p, vocab_size=vocab)
             produced = state.produced + state.active.astype(jnp.int32)
             hit_budget = produced >= state.budget
             hit_eos = (state.eos_id >= 0) & (nxt == state.eos_id)
@@ -866,8 +872,14 @@ class PagedEngine:
             last = jnp.take_along_axis(
                 logits, (n_tokens - 1)[:, None, None], axis=1)[:, 0]
             split = jax.vmap(jax.random.split)(state.rng)
-            nxt = sample_dynamic(last, split[:, 0], state.temperature,
-                                 state.top_k, state.top_p, vocab)
+            # fused decode epilogue (see ops/fused_sampling): the
+            # Pallas kernel reads the (slots, vocab) logits once on
+            # TPU; the XLA reference is the historical sample_dynamic.
+            # Released slots' stale filter params are masked so the
+            # sort short-circuit tracks live traffic.
+            temp, top_k, top_p = _active_sampling_params(state)
+            nxt = fused_sample(last, split[:, 0], temp, top_k, top_p,
+                               vocab_size=vocab)
             # emission is gated on the host plan: a mid-prefill tenant
             # computes but emits nothing, and its rng does NOT advance
             # — the k-th produced token always uses the k-th split, so
@@ -910,10 +922,16 @@ class PagedEngine:
                 keys.append(split[:, 0])
                 chain = split[:, 1]
                 chains.append(chain)
-            sampled = jnp.stack([
-                sample_dynamic(logits[:, j], keys[j], state.temperature,
-                               state.top_k, state.top_p, vocab)
-                for j in range(spec_w)], axis=1)      # (slots, w)
+            # ONE width-axis fused-epilogue call scores all 1+K
+            # positions (the old path paid spec_w separate sorted
+            # sampling tails in this executable); per-position keys
+            # ride the width axis, per-slot params broadcast —
+            # released slots masked, as in the plain step
+            temp, top_k, top_p = _active_sampling_params(state)
+            sampled = fused_sample(
+                logits[:, :spec_w], jnp.stack(keys, axis=1),
+                temp, top_k, top_p,
+                vocab_size=vocab)                     # (slots, w)
             idx = jnp.arange(spec_w, dtype=jnp.int32)
             # draft j+1 accepted iff it equals the token the model
             # would have sampled at its position — the longest

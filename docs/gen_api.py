@@ -27,6 +27,7 @@ PAGES = {
     "core": ["apex_tpu.core.precision", "apex_tpu.core.loss_scale",
              "apex_tpu.core.train_state", "apex_tpu.core.mesh"],
     "ops": ["apex_tpu.ops.attention", "apex_tpu.ops.paged_attention",
+            "apex_tpu.ops.fused_sampling",
             "apex_tpu.ops.multihead_attn",
             "apex_tpu.ops.layer_norm", "apex_tpu.ops.softmax",
             "apex_tpu.ops.rope", "apex_tpu.ops.mlp",

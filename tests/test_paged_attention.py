@@ -596,3 +596,186 @@ class TestPerStepBytesScaleWithLiveTokens:
         # the dense slab pass
         assert paged_small < 0.5 * dense_small, (paged_small,
                                                  dense_small)
+
+
+# --------------------------------------------------------------------- #
+# fused decode prologue (ISSUE 14) — RoPE + write + attend in one op
+# --------------------------------------------------------------------- #
+class TestFusedDecodePrologue:
+    """``paged_decode_fused``: the width-1 decode step's prologue
+    (per-row RoPE → [quantize] → page write) folded into the attend.
+
+    Both sides run under jit (the only way the engines run them): the
+    reference must be the historical unfused sequence verbatim, and
+    the interpret-mode kernel must reproduce the reference's written
+    pages / codes / scales BITWISE on live pages (the null page stays
+    garbage-by-contract on every path) with the attend output equal up
+    to the kernel's blocked accumulation order."""
+
+    def _setup(self, rng, *, b=3, h=8, hk=4, d=32, BS=8, S=64,
+               kv_dtype=None, lengths=None):
+        from apex_tpu.ops.paged_attention import quantize_kv_pages
+        from apex_tpu.ops.rope import rope_cos_sin
+
+        MB = S // BS
+        NB = b * MB + 3
+        kp = jnp.asarray(rng.normal(size=(hk, NB, BS, d)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(hk, NB, BS, d)), jnp.float32)
+        scales = {}
+        if kv_dtype is not None:
+            kp, vp, ks, vs = quantize_kv_pages(kp, vp, kv_dtype)
+            scales = dict(k_scales=ks, v_scales=vs,
+                          chunk_lens=jnp.ones((b,), jnp.int32))
+        if lengths is None:
+            # fresh-page, mid-page and page-boundary-append rows
+            lengths = np.array([5, BS, 3 * BS - 1], np.int32)[:b]
+        tables = np.zeros((b, MB), np.int32)
+        used = rng.permutation(np.arange(1, NB))[: b * MB] \
+            .reshape(b, MB)
+        for r in range(b):
+            npages = min(MB, -(-int(min(lengths[r], S - 1) + 1) // BS))
+            tables[r, :npages] = used[r, :npages]
+        q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+        nk = jnp.asarray(rng.normal(size=(b, 1, hk, d)), jnp.float32)
+        nv = jnp.asarray(rng.normal(size=(b, 1, hk, d)), jnp.float32)
+        cos, sin = rope_cos_sin(S, d)
+        pc = np.minimum(lengths[:, None], S - 1)
+        rope = dict(cos_b=jnp.asarray(cos[pc][:, :, None, :]),
+                    sin_b=jnp.asarray(sin[pc][:, :, None, :]))
+        live = tables.ravel()
+        return (q, nk, nv, kp, vp, jnp.asarray(tables),
+                jnp.asarray(lengths), rope, scales, S,
+                live[live > 0])
+
+    @staticmethod
+    def _run(impl, args, S, rope, scales):
+        from apex_tpu.ops.paged_attention import paged_decode_fused
+        return jax.jit(lambda *a: paged_decode_fused(
+            *a, max_seq_len=S, implementation=impl, **rope,
+            **scales))(*args)
+
+    def test_reference_is_the_unfused_sequence(self):
+        """XLA reference == rope_rows → scatter → gather-attend,
+        composed by hand from the same public pieces — bitwise."""
+        from apex_tpu.ops.paged_attention import (
+            paged_attention_reference, paged_decode_fused_reference,
+            rope_rows)
+
+        rng = np.random.default_rng(3)
+        (q, nk, nv, kp, vp, tables, lengths, rope, _sc, S,
+         _live) = self._setup(rng)
+        got = jax.jit(lambda *a: paged_decode_fused_reference(
+            *a, max_seq_len=S, **rope))(
+            q, nk, nv, kp, vp, tables, lengths)
+
+        def manual(q, nk, nv, kp, vp, tables, lengths):
+            BS, MB = kp.shape[2], tables.shape[1]
+            qm = rope_rows(q, rope["cos_b"], rope["sin_b"])
+            km = rope_rows(nk, rope["cos_b"], rope["sin_b"])
+            pos = lengths[:, None]
+            phys = jnp.take_along_axis(
+                tables, jnp.minimum(pos // BS, MB - 1), axis=1)
+            phys = jnp.where(pos < S, phys, 0)
+            off = pos % BS
+            kp = kp.at[:, phys, off].set(km.transpose(2, 0, 1, 3))
+            vp = vp.at[:, phys, off].set(nv.transpose(2, 0, 1, 3))
+            return (paged_attention_reference(qm, kp, vp, tables,
+                                              lengths), kp, vp)
+
+        ref = jax.jit(manual)(q, nk, nv, kp, vp, tables, lengths)
+        for a, b in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+
+    def test_kernel_matches_reference_unquantized(self):
+        rng = np.random.default_rng(4)
+        (q, nk, nv, kp, vp, tables, lengths, rope, sc, S,
+         live) = self._setup(rng)
+        args = (q, nk, nv, kp, vp, tables, lengths)
+        ref = self._run("xla", args, S, rope, sc)
+        got = self._run("pallas_interpret", args, S, rope, sc)
+        np.testing.assert_allclose(np.asarray(got[0]),
+                                   np.asarray(ref[0]),
+                                   rtol=2e-5, atol=2e-5)
+        # written pages bitwise on live pages (write-then-attend: the
+        # new row IS in the returned pool)
+        for i in (1, 2):
+            np.testing.assert_array_equal(
+                np.asarray(got[i][:, live]), np.asarray(ref[i][:, live]))
+
+    @pytest.mark.parametrize("kv_dtype", _KV_DTYPES)
+    def test_kernel_matches_reference_quantized(self, kv_dtype):
+        """Codes AND monotone running-amax scales bitwise on live
+        pages — the PR-8 scale discipline survives the fusion."""
+        rng = np.random.default_rng(5)
+        (q, nk, nv, kp, vp, tables, lengths, rope, sc, S,
+         live) = self._setup(rng, kv_dtype=kv_dtype)
+        args = (q, nk, nv, kp, vp, tables, lengths)
+        ref = self._run("xla", args, S, rope, sc)
+        got = self._run("pallas_interpret", args, S, rope, sc)
+        np.testing.assert_allclose(np.asarray(got[0]),
+                                   np.asarray(ref[0]),
+                                   rtol=2e-5, atol=2e-5)
+        for i in (1, 2, 3, 4):
+            np.testing.assert_array_equal(
+                np.asarray(got[i][:, live]), np.asarray(ref[i][:, live]))
+
+    def test_no_rope_model_is_fully_bitwise(self):
+        """Learned-position models skip the rotation: the written row
+        is a pure insert, so kernel pool output == reference pool
+        output bit-for-bit on live pages."""
+        rng = np.random.default_rng(6)
+        (q, nk, nv, kp, vp, tables, lengths, _rope, sc, S,
+         live) = self._setup(rng, kv_dtype="int8")
+        args = (q, nk, nv, kp, vp, tables, lengths)
+        ref = self._run("xla", args, S, {}, sc)
+        got = self._run("pallas_interpret", args, S, {}, sc)
+        for i in (1, 2, 3, 4):
+            np.testing.assert_array_equal(
+                np.asarray(got[i][:, live]), np.asarray(ref[i][:, live]))
+
+    def test_past_max_seq_len_routes_to_null_page(self):
+        """A cursor at/past max_seq_len writes the null page on both
+        paths: every LIVE page must be byte-identical to its input
+        (nothing live was touched)."""
+        rng = np.random.default_rng(7)
+        (q, nk, nv, kp, vp, tables, lengths, rope, sc, S,
+         live) = self._setup(rng, lengths=np.array([64, 70, 5],
+                                                   np.int32))
+        args = (q, nk, nv, kp, vp, tables, lengths)
+        for impl in ("xla", "pallas_interpret"):
+            got = self._run(impl, args, S, rope, sc)
+            # rows 0/1 nulled; row 2 wrote its page — all OTHER rows'
+            # live pages unchanged
+            row2 = set(np.asarray(tables)[2].tolist())
+            untouched = [p for p in live.tolist() if p not in row2]
+            np.testing.assert_array_equal(
+                np.asarray(got[1][:, untouched]),
+                np.asarray(kp[:, untouched]))
+
+    def test_width_gt_one_raises(self):
+        from apex_tpu.ops.paged_attention import paged_decode_fused
+
+        rng = np.random.default_rng(8)
+        (q, nk, nv, kp, vp, tables, lengths, rope, sc, S,
+         _live) = self._setup(rng)
+        q2 = jnp.concatenate([q, q], axis=1)
+        nk2 = jnp.concatenate([nk, nk], axis=1)
+        with pytest.raises(ValueError, match="width-1"):
+            paged_decode_fused(q2, nk2, nk2, kp, vp, tables, lengths,
+                               max_seq_len=S)
+
+    def test_scale_argument_validation(self):
+        from apex_tpu.ops.paged_attention import paged_decode_fused
+        from apex_tpu.ops.paged_attention import quantize_kv_pages
+
+        rng = np.random.default_rng(9)
+        (q, nk, nv, kp, vp, tables, lengths, rope, _sc, S,
+         _live) = self._setup(rng)
+        kq, vq, ks, vs = quantize_kv_pages(kp, vp, "int8")
+        with pytest.raises(ValueError, match="need k_scales"):
+            paged_decode_fused(q, nk, nv, kq, vq, tables, lengths,
+                               max_seq_len=S)
+        with pytest.raises(ValueError, match="only apply"):
+            paged_decode_fused(q, nk, nv, kp, vp, tables, lengths,
+                               max_seq_len=S, k_scales=ks, v_scales=vs)

@@ -599,3 +599,77 @@ class TestLlamaGQATwinSlow:
                  for i, L in enumerate((7, 8, 13))]
         assert _drain(single, cases) == _drain(tp, cases)
         assert tp.blocks_in_use == 0
+
+
+# --------------------------------------------------------------------- #
+# fused decode prologue under TP (ISSUE 14): shard-local write
+# --------------------------------------------------------------------- #
+class TestShardedFusedDecodePrologue:
+    """``paged_decode_fused`` over the mesh: the new K/V rows shard on
+    kv_heads beside the pool, the write stays shard-local, and the
+    sharded step is BITWISE the single-chip one — output, written
+    pages, codes and scales (the PR-12 layout is preserved through the
+    fusion)."""
+
+    def _setup(self, rng, *, h, hk, kv_dtype=None, d=16, bs=8, mb=5,
+               b=3, S=None):
+        from apex_tpu.ops.rope import rope_cos_sin
+
+        S = S or mb * bs
+        nb = b * mb + 1
+        kp = jnp.asarray(rng.normal(size=(hk, nb, bs, d)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(hk, nb, bs, d)), jnp.float32)
+        scales = {}
+        if kv_dtype is not None:
+            kp, vp, ks, vs = quantize_kv_pages(kp, vp, kv_dtype)
+            scales = dict(k_scales=ks, v_scales=vs,
+                          chunk_lens=jnp.ones((b,), jnp.int32))
+        tables = jnp.asarray(
+            rng.permutation(np.arange(1, nb))[:b * mb].reshape(b, mb),
+            jnp.int32)
+        lengths = jnp.asarray(
+            rng.integers(0, mb * bs - 1, size=(b,)), jnp.int32)
+        q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+        nk = jnp.asarray(rng.normal(size=(b, 1, hk, d)), jnp.float32)
+        nv = jnp.asarray(rng.normal(size=(b, 1, hk, d)), jnp.float32)
+        cos, sin = rope_cos_sin(S, d)
+        pc = np.minimum(np.asarray(lengths)[:, None], S - 1)
+        rope = dict(cos_b=jnp.asarray(cos[pc][:, :, None, :]),
+                    sin_b=jnp.asarray(sin[pc][:, :, None, :]))
+        return q, nk, nv, kp, vp, tables, lengths, rope, scales, S
+
+    @pytest.mark.parametrize("h,hk", [(4, 4), (8, 4)],
+                             ids=["mha", "gqa"])
+    def test_sharded_matches_unsharded(self, mesh2, h, hk):
+        from apex_tpu.ops.paged_attention import paged_decode_fused
+
+        rng = np.random.default_rng(21)
+        (q, nk, nv, kp, vp, tables, lengths, rope, sc,
+         S) = self._setup(rng, h=h, hk=hk)
+        ref = jax.jit(lambda *a: paged_decode_fused(
+            *a, max_seq_len=S, **rope))(q, nk, nv, kp, vp, tables,
+                                        lengths)
+        tp = jax.jit(lambda *a: paged_decode_fused(
+            *a, max_seq_len=S, **rope, mesh=mesh2,
+            shard_axis=TENSOR_AXIS))(q, nk, nv, kp, vp, tables,
+                                     lengths)
+        for a, b_ in zip(ref, tp):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b_))
+
+    def test_sharded_matches_unsharded_int8(self, mesh2):
+        from apex_tpu.ops.paged_attention import paged_decode_fused
+
+        rng = np.random.default_rng(22)
+        (q, nk, nv, kp, vp, tables, lengths, rope, sc,
+         S) = self._setup(rng, h=8, hk=4, kv_dtype="int8")
+        ref = jax.jit(lambda *a: paged_decode_fused(
+            *a, max_seq_len=S, **rope, **sc))(q, nk, nv, kp, vp,
+                                              tables, lengths)
+        tp = jax.jit(lambda *a: paged_decode_fused(
+            *a, max_seq_len=S, **rope, **sc, mesh=mesh2,
+            shard_axis=TENSOR_AXIS))(q, nk, nv, kp, vp, tables,
+                                     lengths)
+        for a, b_ in zip(ref, tp):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b_))

@@ -38,6 +38,12 @@ SUBSET = [
     # 1+K width.  TestQuantizedKV (ISSUE 8) additionally pins the
     # quantize-on-write scatter + scale reset against real HBM pages
     "tests/test_paged_serving.py",
+    # fused decode epilogue (ISSUE 14): the one-pass sampling kernel
+    # must Mosaic-compile for real (radix descents, in-kernel threefry
+    # replay, VMEM scratch) and its key-for-key chain identity to
+    # sample_dynamic must hold on-chip where the COMPILED kernel — not
+    # interpret mode — draws the tokens
+    "tests/test_fused_sampling.py",
     "tests/test_layer_norm.py",
     "tests/test_ops.py",
     "tests/test_optim.py",
