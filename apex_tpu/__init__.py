@@ -17,6 +17,11 @@ Apex (reference: ``hanjlu13/apex``, a fork of github.com/NVIDIA/apex):
 - ``apex_tpu.transformer`` — tensor / sequence / pipeline / context
   parallelism on a named ``jax.sharding.Mesh`` (Megatron-style port of
   ``apex.transformer``).
+- ``apex_tpu.plan`` — AMP-style auto-parallelism planner (beyond the
+  reference): ``apex_tpu.plan(model_cfg, devices)`` enumerates
+  data/tensor/context/ZeRO/serving layouts, scores them on one unified
+  compute/HBM/ICI cost model, and emits the winning mesh +
+  PartitionSpecs.
 
 Reference citations in docstrings use upstream NVIDIA Apex repo-relative
 paths (e.g. ``apex/amp/frontend.py``); see SURVEY.md for the layer map.
@@ -55,6 +60,7 @@ from apex_tpu import models
 from apex_tpu import ops
 from apex_tpu import optim
 from apex_tpu import parallel
+from apex_tpu import plan
 from apex_tpu import transformer
 from apex_tpu import contrib
 from apex_tpu import resilience
@@ -80,6 +86,7 @@ __all__ = [
     "ops",
     "optim",
     "parallel",
+    "plan",
     "transformer",
     "contrib",
     "resilience",

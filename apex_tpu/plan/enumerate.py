@@ -1,0 +1,492 @@
+"""Layout enumeration + hard HBM feasibility pruning.
+
+The planner's decision space (ROADMAP item 4, per "AMP: Automatically
+Finding Model Parallel Strategies" — arxiv 2210.07297): for a device
+count ``C`` and a model profile, every factorization of ``C`` into
+
+- **train**: ``data × context × tensor`` degrees, crossed with the
+  ZeRO stage (0 = replicated optimizer state, 1/2 = sharded over the
+  data axis) × grad-sync wire dtype (fp32 / bf16 / int8, the
+  ISSUE-8/11 quantized-collective lever) and — when the context axis
+  is used — the sequence-sharded attention implementation (``ring`` or
+  ``ulysses``, where the model supports each);
+- **serve**: ``replicas × tensor`` splits at equal chip count (the
+  ISSUE-13 1×M vs M×1 axis), tensor degrees through the same GQA
+  divisibility gate.
+
+Hard gates are *config-time* library rules, not planner opinions:
+tensor degrees go through
+:func:`apex_tpu.ops.paged_attention.tp_head_shards` (the GQA
+group→shard mapping that ``TransformerConfig.__post_init__`` enforces),
+ulysses through its head-divisibility contract, ring through
+sequence divisibility.  Everything surviving the gates is then pruned
+on **per-chip HBM residency**: params + optimizer state (the
+:func:`~apex_tpu.plan.costs.zero_bytes_on_wire` residency model),
+gradient buffers, activation working set (train) or KV pool (the
+:func:`~apex_tpu.ops.paged_attention.kv_store_bytes_per_token`
+capacity formula) + step temporaries (serve).  A model/device
+combination where *every* layout busts the budget raises
+:class:`InfeasibleError` naming the binding constraint per pruned
+layout — a loud diagnostic, never a silent empty plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from apex_tpu.ops.paged_attention import tp_head_shards
+from apex_tpu.plan import costs
+
+__all__ = [
+    "ModelProfile",
+    "Layout",
+    "InfeasibleError",
+    "profile_of",
+    "generic_profile",
+    "enumerate_layouts",
+    "memory_model",
+    "feasible_layouts",
+]
+
+# Activation-residency calibration: bytes of live residuals per
+# (token, hidden-unit, layer) of a rematted transformer train step.
+# Calibrated against the measured llama_1b bench row (temp 5.57 GB at
+# b=4, s=1024, h=2048, L=20, bf16 → ≈ 8.3 B per token·hidden·layer);
+# coarse on purpose — the planner prunes on it, the chip certifies.
+_ACT_BYTES_PER_TOKEN_HIDDEN_LAYER = 8.0
+
+#: fp32 master + two fp32 Adam moments — the replicated-DP optimizer
+#: residency ``zero_bytes_on_wire`` models (bf16 moments would be 8)
+_OPT_BYTES_PER_PARAM = 12
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelProfile:
+    """The planner's device-free view of a model config.
+
+    Built by :func:`profile_of` from the zoo's config dataclasses
+    (``TransformerConfig`` family, ``ResNetConfig``) or by
+    :func:`generic_profile` for anything else (data-parallel-only
+    models like the simple example's MLP).  All sizes are *analytic* —
+    no parameters are materialized.
+    """
+
+    kind: str                      # "transformer" | "resnet" | "generic"
+    n_params: int
+    dtype_bytes: int = 2           # compute/storage width (bf16 O2)
+    # the EXACT compute dtype name — the autotune cache key component
+    # (PagedEngine keys by str(jnp.dtype(cfg.dtype)); float16 and
+    # bfloat16 share a width but not a cache entry)
+    dtype_name: str = "bfloat16"
+    # transformer geometry (0/None where not applicable)
+    num_layers: int = 0
+    hidden_size: int = 0
+    num_heads: int = 0
+    kv_heads: int = 0
+    head_dim: int = 0
+    vocab_size: int = 0
+    max_seq_len: int = 0
+    causal: bool = False
+    sliding_window: Optional[int] = None
+    # resnet geometry
+    image_size: int = 224
+    stage_sizes: Tuple[int, ...] = ()
+    width: int = 64
+    # generic profiles only: activation residency per sample in BYTES
+    # (transformer/resnet activations are derived from geometry)
+    act_bytes_per_sample: int = 0
+
+    @property
+    def supports_tensor_parallel(self) -> bool:
+        return self.kind == "transformer"
+
+    @property
+    def supports_context_parallel(self) -> bool:
+        # ring/ulysses are causal self-attention shardings
+        return self.kind == "transformer" and self.causal
+
+    @property
+    def supports_serving(self) -> bool:
+        # the paged serving datapath is a causal-decoder layout
+        return self.kind == "transformer" and self.causal
+
+
+def _transformer_n_params(cfg) -> int:
+    """Analytic parameter count of the ``TransformerConfig`` family
+    (GPT/BERT/Llama presets) — matches ``model.init`` to within the
+    norm-scale rounding that never moves a residency decision."""
+    h = cfg.hidden_size
+    kv = cfg.kv_heads
+    head = cfg.head_dim
+    ffn = cfg.ffn_size
+    gated = bool(getattr(cfg, "gated_mlp", False))
+    bias = bool(getattr(cfg, "add_bias_linear", True))
+    # MoE: every layer carries num_moe_experts copies of the MLP plus
+    # the router projection — profiling them as one dense MLP would
+    # pass the HBM feasibility gate for layouts that OOM on chip
+    experts = int(getattr(cfg, "num_moe_experts", None) or 1)
+    mlp = (3 if gated else 2) * h * ffn
+    per_layer = (
+        h * (h + 2 * kv * head)            # qkv projections
+        + h * h                            # out projection
+        + experts * mlp                    # mlp (dense or per-expert)
+        + 2 * h                            # two pre-norms (scale)
+    )
+    if experts > 1:
+        per_layer += h * experts           # router
+    if bias:
+        per_layer += (h + 2 * kv * head) + h + experts * ffn + h
+    n = cfg.num_layers * per_layer
+    n += cfg.vocab_size * h                # embedding
+    if getattr(cfg, "position_embedding", "rope") == "learned":
+        n += cfg.max_seq_len * h
+    n += h                                 # final norm
+    if not getattr(cfg, "tie_embeddings", True):
+        n += h * cfg.vocab_size            # untied head
+    return int(n)
+
+
+def _resnet_n_params(cfg) -> int:
+    """ResNet bottleneck-family parameter count (conv + BN + head)."""
+    width = cfg.width
+    n = 7 * 7 * 3 * width + 2 * width      # stem conv + BN
+    cin = width
+    for i, n_blocks in enumerate(cfg.stage_sizes):
+        f = width * (2 ** i)
+        for j in range(n_blocks):
+            n += cin * f + 2 * f           # 1x1 reduce + BN
+            n += 9 * f * f + 2 * f         # 3x3 + BN
+            n += f * 4 * f + 2 * 4 * f     # 1x1 expand + BN
+            if j == 0:                     # projection shortcut
+                n += cin * 4 * f + 2 * 4 * f
+            cin = 4 * f
+    n += cin * cfg.num_classes + cfg.num_classes
+    return int(n)
+
+
+def profile_of(model_cfg: Any) -> ModelProfile:
+    """Profile a model-zoo config (``TransformerConfig`` family or
+    ``ResNetConfig``); a :class:`ModelProfile` passes through.  For
+    anything else use :func:`generic_profile`."""
+    if isinstance(model_cfg, ModelProfile):
+        return model_cfg
+    # duck-typed on the config families so apex_tpu.plan does not
+    # import flax model modules at call time
+    import jax.numpy as jnp
+
+    if hasattr(model_cfg, "num_heads") and hasattr(model_cfg,
+                                                   "vocab_size"):
+        # dtype=None (the O1 interceptor style) computes in bf16
+        dt = jnp.dtype(model_cfg.dtype if model_cfg.dtype is not None
+                       else jnp.bfloat16)
+        return ModelProfile(
+            kind="transformer",
+            n_params=_transformer_n_params(model_cfg),
+            dtype_bytes=min(int(dt.itemsize), 4),
+            dtype_name=dt.name,
+            num_layers=model_cfg.num_layers,
+            hidden_size=model_cfg.hidden_size,
+            num_heads=model_cfg.num_heads,
+            kv_heads=model_cfg.kv_heads,
+            head_dim=model_cfg.head_dim,
+            vocab_size=model_cfg.vocab_size,
+            max_seq_len=model_cfg.max_seq_len,
+            causal=bool(model_cfg.causal),
+            sliding_window=getattr(model_cfg, "sliding_window", None))
+    if hasattr(model_cfg, "stage_sizes") and hasattr(model_cfg,
+                                                     "num_classes"):
+        dt = jnp.dtype(model_cfg.dtype)
+        return ModelProfile(
+            kind="resnet",
+            n_params=_resnet_n_params(model_cfg),
+            dtype_bytes=min(int(dt.itemsize), 4),
+            dtype_name=dt.name,
+            stage_sizes=tuple(model_cfg.stage_sizes),
+            width=model_cfg.width)
+    raise TypeError(
+        f"cannot profile {type(model_cfg).__name__}: pass a "
+        f"TransformerConfig-family or ResNetConfig instance, a "
+        f"ModelProfile, or build one with plan.generic_profile(...)")
+
+
+def generic_profile(n_params: int, *, dtype_bytes: int = 4,
+                    act_bytes_per_sample: int = 0) -> ModelProfile:
+    """Profile an arbitrary model by parameter count alone — the
+    data-parallel-only escape hatch (no tensor/context sharding is
+    enumerated because the planner knows nothing about the
+    architecture).  ``act_bytes_per_sample`` feeds the activation
+    residency column (0 = negligible, fine for small nets)."""
+    return ModelProfile(kind="generic", n_params=int(n_params),
+                        dtype_bytes=int(dtype_bytes),
+                        dtype_name={2: "bfloat16", 4: "float32"}.get(
+                            int(dtype_bytes), "float32"),
+                        act_bytes_per_sample=int(act_bytes_per_sample))
+
+
+@dataclasses.dataclass(frozen=True)
+class Layout:
+    """One point of the decision space.
+
+    Train: ``dp × cp × tp`` mesh degrees + ZeRO stage/wire; serve:
+    ``dp`` is the replica count and ``tp`` the chips per replica
+    (``cp``/``zero_stage`` stay at their neutral values).  ``attn`` is
+    the context-sharded attention implementation (``"local"`` when
+    ``cp == 1``).
+    """
+
+    objective: str = "train"         # "train" | "serve"
+    dp: int = 1
+    cp: int = 1
+    tp: int = 1
+    zero_stage: int = 0              # 0 | 1 | 2
+    reduce_dtype: Optional[str] = None   # None(fp32) | "bf16" | "int8"
+    attn: str = "local"              # "local" | "ring" | "ulysses"
+
+    @property
+    def chips(self) -> int:
+        return self.dp * self.cp * self.tp
+
+    def describe(self) -> str:
+        if self.objective == "serve":
+            return f"{self.dp}x{self.tp} (replicas x tp)"
+        bits = [f"dp={self.dp}"]
+        if self.cp > 1:
+            bits.append(f"cp={self.cp}({self.attn})")
+        if self.tp > 1:
+            bits.append(f"tp={self.tp}")
+        if self.zero_stage:
+            wire = self.reduce_dtype or "fp32"
+            bits.append(f"zero{self.zero_stage}/{wire}")
+        return " ".join(bits)
+
+
+class InfeasibleError(ValueError):
+    """Every enumerated layout busts the per-chip HBM budget.
+
+    ``pruned`` holds ``(layout, components)`` pairs; the message lists
+    the binding constraint (largest residency component) per layout so
+    the caller can see *why* — grow the budget, shrink the model, or
+    add chips."""
+
+    def __init__(self, message: str,
+                 pruned: List[Tuple[Layout, Dict[str, int]]]):
+        super().__init__(message)
+        self.pruned = pruned
+
+
+def _tp_ok(profile: ModelProfile, tp: int) -> bool:
+    if tp == 1:
+        return True
+    if not profile.supports_tensor_parallel:
+        return False
+    try:
+        # the loud library gate (GQA groups cannot straddle shards)
+        tp_head_shards(profile.num_heads, profile.kv_heads, tp)
+    except ValueError:
+        return False
+    return True
+
+
+def _attn_impls(profile: ModelProfile, cp: int,
+                seq: Optional[int] = None) -> List[str]:
+    """Context-sharded attention implementations legal at degree
+    ``cp`` — the same divisibility contracts the parallel ops
+    enforce at trace time, checked against the sequence length the
+    caller actually plans with (``seq``; the config's
+    ``max_seq_len`` otherwise)."""
+    if cp == 1:
+        return ["local"]
+    if not profile.supports_context_parallel:
+        return []
+    impls = []
+    if (seq or profile.max_seq_len) % cp == 0:
+        impls.append("ring")
+    h, hk = profile.num_heads, profile.kv_heads
+    if h % cp == 0 and (hk % cp == 0 or cp % hk == 0):
+        impls.append("ulysses")
+    return impls
+
+
+def enumerate_layouts(profile: ModelProfile, n_devices: int,
+                      objective: str = "train", *,
+                      seq: Optional[int] = None) -> List[Layout]:
+    """Every gate-passing layout for ``n_devices`` chips (no HBM
+    pruning — that is :func:`feasible_layouts`' job).  ``seq`` is the
+    sequence length the caller trains at (the ring gate's
+    divisibility operand); defaults to the config's ``max_seq_len``."""
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n}")
+    if objective not in ("train", "serve"):
+        raise ValueError(
+            f"objective={objective!r} not in ('train', 'serve')")
+    profile = profile_of(profile)
+    out: List[Layout] = []
+    if objective == "serve":
+        if not profile.supports_serving:
+            raise ValueError(
+                "objective='serve' needs a causal decoder config "
+                "(the paged serving datapath) — got "
+                f"kind={profile.kind!r}, causal={profile.causal}")
+        for tp in _divisors(n):
+            if not _tp_ok(profile, tp):
+                continue
+            out.append(Layout(objective="serve", dp=n // tp, tp=tp))
+        return out
+    for dp in _divisors(n):
+        for cp in _divisors(n // dp):
+            tp = n // (dp * cp)
+            if not _tp_ok(profile, tp):
+                continue
+            for attn in _attn_impls(profile, cp, seq):
+                for stage in (0, 1, 2):
+                    if stage and dp < 2:
+                        continue       # nothing to shard over
+                    wires = ([None] if stage == 0
+                             else [None, "bf16", "int8"])
+                    for wire in wires:
+                        out.append(Layout(
+                            objective="train", dp=dp, cp=cp, tp=tp,
+                            zero_stage=stage, reduce_dtype=wire,
+                            attn=attn))
+    return out
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def memory_model(profile: ModelProfile, layout: Layout, *,
+                 batch_per_chip: int = 1,
+                 seq: Optional[int] = None,
+                 slots: int = 8,
+                 pool_tokens: Optional[int] = None,
+                 block_size: int = 16,
+                 kv_dtype: Optional[str] = None,
+                 opt_bytes_per_param: int = _OPT_BYTES_PER_PARAM
+                 ) -> Dict[str, int]:
+    """Per-chip HBM residency of ``layout`` — the pruning columns.
+
+    Train components: ``params`` (storage-dtype replica, tensor-
+    sharded), ``optimizer_state`` (the
+    :func:`~apex_tpu.plan.costs.zero_bytes_on_wire` residency model:
+    replicated 12 B/param at stage 0, ``params + 12/n`` sharded under
+    ZeRO), ``gradients`` (fp32; reduce-scattered to a shard under
+    stage 2), ``activations`` (rematted-residual estimate calibrated
+    against the llama_1b bench temp row) and ``logits`` (the CE
+    residual, vocab-sharded under tp).
+
+    Serve components: ``params`` (bf16 inference replica / tp),
+    ``kv_pool`` (the :func:`kv_store_bytes_per_token` capacity formula
+    over ``pool_tokens``, kv-head-sharded under tp) and ``logits``
+    (the ``(slots, vocab)`` step tail).  ``total`` sums the dict.
+    """
+    profile = profile_of(profile)
+    n, tp = profile.n_params, layout.tp
+    comp: Dict[str, int] = {}
+    if layout.objective == "serve":
+        comp["params"] = int(n * profile.dtype_bytes / tp)
+        ptok = pool_tokens if pool_tokens is not None \
+            else slots * profile.max_seq_len
+        per_tok = (profile.kv_heads * profile.num_layers
+                   * costs.kv_store_bytes_per_token(
+                       profile.head_dim, block_size, kv_dtype,
+                       dtype=profile.dtype_name))
+        comp["kv_pool"] = int(ptok * per_tok / tp)
+        comp["logits"] = int(slots * profile.vocab_size * 4 / tp)
+    else:
+        s = seq or profile.max_seq_len or 1
+        comp["params"] = int(n * profile.dtype_bytes / tp)
+        if layout.zero_stage:
+            zm = costs.zero_bytes_on_wire(
+                n / tp, layout.dp, stage=layout.zero_stage,
+                param_bytes=profile.dtype_bytes,
+                opt_bytes_per_param=opt_bytes_per_param)
+            # the zero residency already counts the param replica —
+            # subtract it so `params` is not double-charged
+            comp["optimizer_state"] = int(
+                zm["model_state_bytes_per_chip_zero"]
+                - comp["params"])
+        else:
+            comp["optimizer_state"] = int(opt_bytes_per_param * n / tp)
+        grad_shards = layout.dp if layout.zero_stage == 2 else 1
+        comp["gradients"] = int(4 * n / tp / grad_shards)
+        if profile.kind == "transformer":
+            comp["activations"] = int(
+                _ACT_BYTES_PER_TOKEN_HIDDEN_LAYER * batch_per_chip
+                * s * profile.hidden_size * profile.num_layers
+                / (layout.cp * tp))
+            # fp32 CE residual over the (b, s, vocab) logits — the
+            # sequence axis shards on context, the vocab axis on
+            # tensor, so both degrees divide the per-chip residual
+            comp["logits"] = int(4 * batch_per_chip * s
+                                 * profile.vocab_size
+                                 / (layout.cp * tp))
+        elif profile.kind == "resnet":
+            comp["activations"] = int(
+                _resnet_act_elems(profile) * batch_per_chip
+                * profile.dtype_bytes * 2)   # residents + grad mirror
+        else:
+            comp["activations"] = int(profile.act_bytes_per_sample
+                                      * batch_per_chip)
+    comp["total"] = sum(comp.values())
+    return comp
+
+
+def _resnet_act_elems(profile: ModelProfile) -> int:
+    """Per-sample activation element count of the bottleneck stack —
+    :func:`~apex_tpu.plan.costs.resnet_conv_shapes`' conv outputs
+    counted once (residency, not passes; the traffic model counts the
+    same shapes as read/write PASSES)."""
+    return int(sum(o for _i, o, _bn in costs.resnet_conv_shapes(
+        profile.image_size, profile.stage_sizes, profile.width)))
+
+
+def feasible_layouts(profile: ModelProfile, n_devices: int,
+                     objective: str, *, hbm_bytes: float,
+                     seq: Optional[int] = None,
+                     per_layout_kwargs=None,
+                     **mm_kwargs) -> List[Tuple[Layout,
+                                                Dict[str, int]]]:
+    """Enumerate + prune: the gate-passing layouts whose
+    :func:`memory_model` total fits ``hbm_bytes``, each paired with
+    its residency breakdown.  ``per_layout_kwargs`` (layout → dict)
+    lets the caller vary :func:`memory_model` inputs per layout —
+    ``plan()`` uses it to judge each serving split on the SAME
+    autotuned pool its score (and emitted engine kwargs) adopt.
+    Raises :class:`InfeasibleError` (with the per-layout binding
+    constraint) when nothing survives."""
+    profile = profile_of(profile)
+    layouts = enumerate_layouts(profile, n_devices, objective,
+                                seq=seq)
+    kept, pruned = [], []
+    for layout in layouts:
+        kw = dict(mm_kwargs)
+        if objective == "train":
+            kw.setdefault("seq", seq)
+        if per_layout_kwargs is not None:
+            kw.update(per_layout_kwargs(layout))
+        comp = memory_model(profile, layout, **kw)
+        if comp["total"] <= hbm_bytes:
+            kept.append((layout, comp))
+        else:
+            pruned.append((layout, comp))
+    if not kept:
+        lines = [
+            f"no feasible layout for {n_devices} device(s) at "
+            f"{hbm_bytes / 1e9:.1f} GB/chip (objective="
+            f"{objective!r}); binding constraint per pruned layout:"]
+        for layout, comp in pruned:
+            binding = max(
+                (k for k in comp if k != "total"),
+                key=lambda k: comp[k])
+            lines.append(
+                f"  - {layout.describe()}: total "
+                f"{comp['total'] / 1e9:.2f} GB "
+                f"(binding: {binding} = {comp[binding] / 1e9:.2f} GB)")
+        lines.append(
+            "  -> grow hbm_bytes, add devices, or shrink the model "
+            "(batch/seq/slots)")
+        raise InfeasibleError("\n".join(lines), pruned)
+    return kept

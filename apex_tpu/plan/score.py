@@ -1,0 +1,316 @@
+"""Roofline scoring of feasible layouts.
+
+The score is the bench scoreboard's own unit — samples/sec/chip for
+training, tokens/sec/chip for serving — predicted from the same
+three-term roofline the measured legs certify against:
+
+- **MXU**: analytic step FLOPs (6·P per trained token + the causal
+  attention term; 2·P per decoded token) at the chip's peak, or —
+  exactly the way the bench legs seed their rooflines — the numbers of
+  an XLA ``cost_analysis()`` when the caller compiled a real step and
+  passes them via ``cost_seed`` (:func:`xla_cost_seed` extracts them).
+- **HBM**: per-chip resident-state streaming (masters/moments/params,
+  the :func:`~apex_tpu.plan.costs.zero_bytes_on_wire` residency) +
+  activation traffic for training; the param stream + the
+  :func:`~apex_tpu.plan.costs.serving_traffic_model` paged KV gather +
+  the :func:`~apex_tpu.plan.costs.sampling_cost_bytes` epilogue for
+  serving.
+- **ICI**: the grad-sync wire (:func:`~apex_tpu.plan.costs.
+  ddp_bytes_on_wire` / ``zero_bytes_on_wire``) for training; the
+  tensor-parallel RowParallel all-reduce column for serving.
+
+Kernel-shaped serving terms adopt the **autotuned winners** where a
+sweep ran on this hardware (:mod:`apex_tpu.ops.autotune`), queried
+under the PER-SHARD kv-head count exactly as ``PagedEngine`` does
+(PR-12 rule: a tp engine must never adopt a block size swept at full
+head count).  A cache miss falls back to the analytic estimate at the
+engine's defaults and increments the ``plan.autotune_miss`` counter
+(:data:`apex_tpu.utils.metrics.counters`) — never a silent zero score.
+
+Absolute numbers are estimates; *orderings* are the contract —
+``tests/test_plan.py::TestPredictionFidelity`` pins the planner's
+relative orderings against the recorded bench rows (dense-vs-paged,
+dp-vs-zero2 hbm_peak, 1×M-vs-M×1 per-chip tokens/s, the
+occupancy-sweep curve shape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from apex_tpu.plan import costs
+from apex_tpu.plan.enumerate import (
+    Layout,
+    ModelProfile,
+    memory_model,
+    profile_of,
+)
+from apex_tpu.utils.metrics import counters
+
+__all__ = [
+    "HardwareSpec",
+    "DEFAULT_HW",
+    "score_layout",
+    "xla_cost_seed",
+    "autotuned_paged_layout",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class HardwareSpec:
+    """Per-chip peaks the roofline scores against.
+
+    Defaults match the bench harness's assumed peaks (``bench.py``:
+    197 bf16 TFLOP/s, 819 GB/s HBM) plus a ring-ICI estimate and a
+    32 GB HBM feasibility budget — override per deployment
+    (``apex_tpu.plan(..., hw=HardwareSpec(...))``); the planner's
+    *orderings* are insensitive to uniform rescaling.
+    """
+
+    peak_tflops: float = 197.0
+    peak_hbm_gbs: float = 819.0
+    peak_ici_gbs: float = 90.0
+    hbm_bytes: float = 32e9
+
+
+DEFAULT_HW = HardwareSpec()
+
+
+def xla_cost_seed(compiled) -> Optional[Dict[str, float]]:
+    """Extract ``{"flops", "bytes_accessed"}`` from a
+    ``jax.stages.Compiled`` — the bench legs' roofline seed
+    (``bench._roofline_fields`` reads the same two columns).  Pass the
+    result as ``cost_seed=`` to :func:`score_layout` to anchor the
+    MXU/HBM terms in the compiled step instead of the analytic
+    estimates.  Compile the SINGLE-CHIP (unsharded) step at the same
+    per-chip batch/seq you plan with: the scorer rescales the seed by
+    each layout's model-sharding degree (``cp × tp``), so one seed
+    ranks the whole space instead of silently making every layout's
+    roofline identical.  Returns None when the backend offers no
+    analysis."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        flops = float((ca or {}).get("flops", 0.0))
+        byts = float((ca or {}).get("bytes accessed", 0.0))
+    except Exception:
+        return None
+    if not flops and not byts:
+        return None
+    return {"flops": flops, "bytes_accessed": byts}
+
+
+def autotuned_paged_layout(profile: ModelProfile,
+                           tp: int) -> Dict[str, Any]:
+    """The (block_size, kv_dtype) the serving engine would adopt on
+    this hardware — the measured winner when a
+    ``tune_paged_attention`` sweep ran at THIS shard width, else the
+    engine's analytic defaults with a counted miss.
+
+    Mirrors ``PagedEngine``'s lookup exactly: the cache key carries
+    the PER-SHARD kv-head count (``kv_heads // tp``) and a missing
+    per-shard entry never falls back to the full-head-count winner —
+    it falls back to the *analytic* defaults (block 16, unquantized)
+    and increments ``plan.autotune_miss`` so a deployment can see the
+    sweep it should run (the PR-12 no-aliasing rule, negative-tested).
+    """
+    from apex_tpu.ops import autotune
+
+    shard_kv_heads = max(1, profile.kv_heads // tp)
+    pair = autotune.cached_paged_pair(
+        profile.head_dim, profile.dtype_name,
+        kv_heads=shard_kv_heads)
+    if pair is not None:
+        return {"block_size": pair[0], "kv_dtype": pair[1],
+                "autotuned": True}
+    counters.inc("plan.autotune_miss")
+    return {"block_size": 16, "kv_dtype": None, "autotuned": False}
+
+
+def _train_flops_per_chip(profile: ModelProfile, layout: Layout,
+                          batch_per_chip: int, seq: int) -> float:
+    """fwd+bwd FLOPs per chip per step: the 6·P-per-token dense term
+    + the causal flash-attention term (windowed where the model is)."""
+    tokens_per_chip = batch_per_chip * seq
+    dense = 6.0 * profile.n_params * tokens_per_chip \
+        / (layout.cp * layout.tp)
+    attn = 0.0
+    if profile.kind == "transformer":
+        w = min(profile.sliding_window or seq, seq)
+        visible = (w + 1) / 2 if w == seq else w   # mean kv per query
+        attn = (12.0 * profile.num_layers * profile.num_heads
+                * profile.head_dim * visible * tokens_per_chip
+                / (layout.cp * layout.tp))
+    return dense + attn
+
+
+def score_layout(profile: ModelProfile, layout: Layout, *,
+                 hw: HardwareSpec = DEFAULT_HW,
+                 batch_per_chip: int = 1,
+                 seq: Optional[int] = None,
+                 slots: int = 8,
+                 live_tokens: Optional[int] = None,
+                 cost_seed: Optional[Dict[str, float]] = None,
+                 slo: Optional[Dict[str, float]] = None,
+                 tuned: Optional[Dict[str, Any]] = None,
+                 residency: Optional[Dict[str, int]] = None
+                 ) -> Dict[str, Any]:
+    """Roofline-score one layout; higher ``value`` is better.
+
+    Returns a dict with ``value`` (samples/sec/chip or
+    tokens/sec/chip), the three roofline times (``t_mxu_s`` /
+    ``t_hbm_s`` / ``t_ici_s``), the binding ``bound``, the residency
+    breakdown, the wire model, and — serving — the traffic model +
+    autotune adoption and modeled ``ttft_ms`` (``slo_met`` when an
+    ``slo={"ttft_ms": ...}`` bound was given).  ``residency`` reuses a
+    :func:`~apex_tpu.plan.enumerate.memory_model` breakdown the
+    caller already computed (``plan()`` passes the feasibility pass's
+    own — the pruning and the reported residency can never diverge).
+    """
+    profile = profile_of(profile)
+    if layout.objective == "serve":
+        return _score_serve(profile, layout, hw, slots,
+                            live_tokens, slo, tuned, residency)
+    seq = seq or profile.max_seq_len or 1
+    comp = residency or memory_model(
+        profile, layout, batch_per_chip=batch_per_chip, seq=seq,
+        slots=slots)
+    if cost_seed:
+        # the seed describes the SINGLE-CHIP step: each layout's
+        # model-sharding degree divides its per-chip work (without
+        # this every layout would score an identical roofline and the
+        # ranking would degenerate to max-dp)
+        shard = layout.cp * layout.tp
+        flops = cost_seed["flops"] / shard
+        hbm_bytes = cost_seed["bytes_accessed"] / shard
+    else:
+        flops = _train_flops_per_chip(profile, layout,
+                                      batch_per_chip, seq)
+        # per-step streaming: params read fwd+bwd, fp32 master/moment
+        # read+write around the update, grads written+read, plus the
+        # calibrated activation working set streamed ~once each way
+        hbm_bytes = (2.0 * comp["params"]
+                     + 2.5 * comp["optimizer_state"]
+                     + 2.0 * comp["gradients"]
+                     + 2.0 * comp.get("activations", 0)
+                     + 2.0 * comp.get("logits", 0))
+    t_mxu = flops / (hw.peak_tflops * 1e12)
+    t_hbm = hbm_bytes / (hw.peak_hbm_gbs * 1e9)
+    # grad-sync wire per step (the data axis)
+    shard_params = profile.n_params / (layout.cp * layout.tp)
+    if layout.dp > 1:
+        if layout.zero_stage:
+            zw = costs.zero_bytes_on_wire(
+                shard_params, layout.dp, stage=layout.zero_stage,
+                reduce_dtype=layout.reduce_dtype or "fp32",
+                param_bytes=profile.dtype_bytes)
+            wire = zw["wire_bytes_per_step_zero"]
+        else:
+            dw = costs.ddp_bytes_on_wire(shard_params, layout.dp)
+            wire = dw["wire_bytes_per_step_fp32"]
+    else:
+        wire = 0
+    # tensor/context axes are not free either: per layer the TP block
+    # pays two all-gather/reduce-scatter pairs over the (b, s, h)
+    # activations fwd + the mirrored pair bwd (the sequence-parallel
+    # choreography); ring/ulysses circulate the per-chip K/V (or
+    # all-to-all the head swap) around the context ring — both at the
+    # ring wire cost of (n-1)/n × payload per chip per leg
+    if profile.kind == "transformer":
+        act = (batch_per_chip * seq * profile.hidden_size
+               * profile.dtype_bytes / layout.cp)
+        if layout.tp > 1:
+            wire += (8 * profile.num_layers * act
+                     * (layout.tp - 1) / layout.tp)
+        if layout.cp > 1:
+            kv = (batch_per_chip * seq * profile.kv_heads
+                  * profile.head_dim * 2 * profile.dtype_bytes)
+            wire += (3 * profile.num_layers * kv
+                     * (layout.cp - 1) / layout.cp)
+    t_ici = wire / (hw.peak_ici_gbs * 1e9)
+    step = max(t_mxu, t_hbm) + t_ici
+    global_samples = batch_per_chip * layout.dp
+    value = global_samples / step / layout.chips
+    return {
+        "objective": "train",
+        "layout": layout,
+        "value": value,
+        "unit": "samples/sec/chip",
+        "step_s": step,
+        "t_mxu_s": t_mxu,
+        "t_hbm_s": t_hbm,
+        "t_ici_s": t_ici,
+        "bound": ("ici" if t_ici > max(t_mxu, t_hbm)
+                  else "mxu" if t_mxu >= t_hbm else "hbm"),
+        "hbm_residency": comp,
+        "wire_bytes_per_step": int(wire),
+        "cost_seed": cost_seed,
+    }
+
+
+def _score_serve(profile: ModelProfile, layout: Layout,
+                 hw: HardwareSpec, slots: int,
+                 live_tokens: Optional[int],
+                 slo: Optional[Dict[str, float]],
+                 tuned: Optional[Dict[str, Any]] = None,
+                 residency: Optional[Dict[str, int]] = None
+                 ) -> Dict[str, Any]:
+    live = live_tokens or min(256, profile.max_seq_len)
+    if tuned is None:
+        tuned = autotuned_paged_layout(profile, layout.tp)
+    tm = costs.serving_traffic_model(
+        num_layers=profile.num_layers, kv_heads=profile.kv_heads,
+        head_dim=profile.head_dim, max_seq_len=profile.max_seq_len,
+        live_tokens=live, slots=slots,
+        block_size=tuned["block_size"],
+        dtype_bytes=profile.dtype_bytes,
+        kv_dtype=tuned["kv_dtype"],
+        tp=layout.tp, hidden_size=profile.hidden_size)
+    comp = residency or memory_model(
+        profile, layout, slots=slots,
+        block_size=tuned["block_size"], kv_dtype=tuned["kv_dtype"])
+    kv_key = ("paged_kv_read_bytes_per_step_per_chip_quantized"
+              if tuned["kv_dtype"] else
+              "paged_kv_read_bytes_per_step_per_chip")
+    # the decode step per chip: param stream + live-page gather + the
+    # one-pass sampling epilogue (vocab-sharded under tp)
+    hbm_bytes = (profile.n_params * profile.dtype_bytes / layout.tp
+                 + tm[kv_key]
+                 + costs.sampling_cost_bytes(
+                     slots, profile.vocab_size, "float32") / layout.tp)
+    flops = 2.0 * profile.n_params * slots / layout.tp
+    t_mxu = flops / (hw.peak_tflops * 1e12)
+    t_hbm = hbm_bytes / (hw.peak_hbm_gbs * 1e9)
+    t_ici = tm["ici_bytes_per_step_per_chip"] / (hw.peak_ici_gbs * 1e9)
+    step = max(t_mxu, t_hbm) + t_ici
+    # each of the dp replicas emits `slots` tokens per step over
+    # tp chips — per-chip tokens/s is replica-count-invariant by
+    # construction (the Gemma-paper per-chip unit)
+    value = slots / (step * layout.tp)
+    # TTFT: one full-prompt prefill through the tp shard's MXU
+    ttft_s = (2.0 * profile.n_params * live
+              / (layout.tp * hw.peak_tflops * 1e12))
+    out = {
+        "objective": "serve",
+        "layout": layout,
+        "value": value,
+        "unit": "tokens/sec/chip",
+        "step_s": step,
+        "t_mxu_s": t_mxu,
+        "t_hbm_s": t_hbm,
+        "t_ici_s": t_ici,
+        "bound": ("ici" if t_ici > max(t_mxu, t_hbm)
+                  else "mxu" if t_mxu >= t_hbm else "hbm"),
+        "hbm_residency": comp,
+        "traffic_model": tm,
+        "autotune": tuned,
+        "ttft_ms": ttft_s * 1e3,
+        "slots": slots,
+        "live_tokens": live,
+    }
+    if slo and "ttft_ms" in slo:
+        out["ttft_slo_ms"] = float(slo["ttft_ms"])
+        out["slo_met"] = bool(out["ttft_ms"] <= slo["ttft_ms"])
+    return out

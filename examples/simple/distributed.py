@@ -22,7 +22,14 @@ The loop runs under ``apex_tpu.resilience.ResilientLoop`` — with
 and auto-resumes on relaunch; without, the wrapper is a near-free
 pass-through (the ``resilience_overhead`` bench leg quantifies it).
 
+``--plan auto`` (ISSUE 15) stops hand-picking the layout entirely:
+the ZeRO stage and wire dtype come from ``apex_tpu.plan()`` over a
+parameter-count profile of the net (data-parallel only — the planner
+knows nothing about an arbitrary flax module's insides).  An explicit
+``--zero`` still wins.
+
   python examples/simple/distributed.py [--zero 2] [--ckpt-dir /tmp/d]
+  python examples/simple/distributed.py --plan auto
 """
 
 from __future__ import annotations
@@ -54,12 +61,18 @@ def main():
     ap.add_argument("--ckpt-dir", default=None,
                     help="rolling checkpoints + auto-resume here")
     ap.add_argument("--steps", type=int, default=50)
-    ap.add_argument("--zero", type=int, default=0, choices=(0, 1, 2),
+    ap.add_argument("--zero", type=int, default=None,
+                    choices=(0, 1, 2),
                     help="ZeRO stage: 0 = replicated optimizer state, "
                          "1 = sharded state + all-reduce grads, "
-                         "2 = sharded state + reduce-scatter grads")
+                         "2 = sharded state + reduce-scatter grads "
+                         "(unset + --plan auto = planner's choice)")
     ap.add_argument("--zero-int8", action="store_true",
                     help="int8 quantized wire for the ZeRO grad sync")
+    ap.add_argument("--plan", choices=("auto",), default=None,
+                    help="auto = route the ZeRO/wire layout choice "
+                         "through apex_tpu.plan() (explicit --zero "
+                         "still wins)")
     args = ap.parse_args()
     if args.zero_int8 and not args.zero:
         ap.error("--zero-int8 needs --zero 1 or 2 (the int8 wire is "
@@ -75,7 +88,22 @@ def main():
     net = Net()
     params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 16)))["params"]
     zero = None
-    if args.zero:
+    if args.plan == "auto" and args.zero is None:
+        # route the layout choice through the planner (ISSUE 15): a
+        # parameter-count profile is all an arbitrary flax net can
+        # offer, so the decision space is dp × ZeRO stage × wire — the
+        # emitted ZeroConfig is committed exactly like a hand-set one
+        import apex_tpu
+
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        planned = apex_tpu.plan(
+            apex_tpu.plan.generic_profile(n_params), devices=ndev,
+            objective="train")
+        zero = planned.zero
+        print(f"plan: auto -> {planned.layout.describe()} "
+              f"({planned.score['value']:.0f} samples/s/chip modeled, "
+              f"{len(planned.alternatives)} alternatives scored)")
+    elif args.zero:
         zero = ZeroConfig(
             axis="data", stage=args.zero,
             reduce_dtype="int8" if args.zero_int8 else None,
@@ -97,9 +125,11 @@ def main():
         shard_bytes = sum(
             int(np.prod(l.sharding.shard_shape(l.shape))) * l.dtype.itemsize
             for l in jax.tree.leaves(state.opt_state))
-        print(f"zero: stage {args.zero} over {ndev}-way 'data' axis, "
-              f"reduce_dtype="
-              f"{'int8' if args.zero_int8 else 'fp32'}, "
+        wire = ("int8" if zero.reduce_dtype == "int8"
+                else "fp32" if zero.reduce_dtype is None
+                else str(jnp.dtype(zero.reduce_dtype)))
+        print(f"zero: stage {zero.stage} over {ndev}-way 'data' axis, "
+              f"reduce_dtype={wire}, "
               f"optimizer-state shard {shard_bytes} B/device "
               f"(~1/{ndev} of replicated)")
         specs = zero_state_specs(state)

@@ -143,6 +143,34 @@ class TestDistributedExample:
         assert all(np.isfinite(float(l)) for l in losses)
         assert float(losses[-1]) < float(losses[0])
 
+    def test_plan_auto_routes_layout(self):
+        # ISSUE-15 satellite: --plan auto stops hand-picking the
+        # layout — the ZeRO stage/wire come from apex_tpu.plan() over
+        # a parameter-count profile; training must still converge on
+        # the planned layout
+        r = _run_example("examples/simple/distributed.py",
+                         ["--plan", "auto", "--steps", "20"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "plan: auto -> dp=8" in r.stdout, r.stdout[-2000:]
+        assert "alternatives scored" in r.stdout
+        losses = re.findall(r"loss (\d+\.\d+)", r.stdout)
+        assert losses, r.stdout[-2000:]
+        assert float(losses[-1]) < float(losses[0])
+
+    @pytest.mark.slow
+    def test_plan_auto_yields_to_explicit_zero(self):
+        # [slow: a second subprocess run of the example; the
+        # explicit-flag precedence itself is argument plumbing — the
+        # tier-1 smoke above keeps the planner path exercised]
+        # explicit flags still win: --zero 1 pins the stage, the
+        # planner is never consulted
+        r = _run_example("examples/simple/distributed.py",
+                         ["--plan", "auto", "--zero", "1",
+                          "--steps", "12"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "plan: auto" not in r.stdout
+        assert "zero: stage 1" in r.stdout, r.stdout[-2000:]
+
     @pytest.mark.slow
     def test_zero1_int8_wire_trains(self):
         # [slow: a second subprocess run of the same example; the
@@ -223,6 +251,42 @@ class TestServingDemoExample:
         assert r.stdout.count("req ") == 4, r.stdout[-2000:]
         assert "fleet: replicas=2 ready=2 chips_per_replica=2 " \
                "chips_total=4" in r.stdout, r.stdout[-2000:]
+        assert "done: 4 requests" in r.stdout, r.stdout[-2000:]
+
+    @pytest.mark.slow
+    def test_plan_auto_respects_pinned_axis(self):
+        # [slow: a serving subprocess warming a 2-chip TP replica ≈
+        # 30s like the --tp smoke]  review regression: an explicit
+        # flag PINS its axis — with replicas pinned at 1 on a 2-chip
+        # budget the planner must pick the scored 1x2 TP split (never
+        # graft an unscored combination or override the pin)
+        r = _run_example("examples/serving_demo.py",
+                         ["--plan", "auto", "--chips", "2",
+                          "--replicas", "1", "--requests", "4",
+                          "--max-slots", "2"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "plan: auto -> 1x2 (replicas x tp)" in r.stdout, \
+            r.stdout[-2000:]
+        assert "tp: chips_per_replica=2" in r.stdout, r.stdout[-2000:]
+        assert "done: 4 requests" in r.stdout, r.stdout[-2000:]
+
+    @pytest.mark.slow
+    def test_plan_auto_serves_planned_split(self):
+        # [slow: a serving subprocess warming a 2-replica fleet ≈ 25s
+        # like the --replicas smoke; the planner itself is
+        # tier-1-covered by test_plan.py]  ISSUE-15 satellite: the
+        # replicas×tp split comes from apex_tpu.plan(objective=
+        # "serve") — on a 2-chip budget the per-chip score picks the
+        # 2×1 fleet (the tp_serving protocol's throughput ceiling)
+        r = _run_example("examples/serving_demo.py",
+                         ["--plan", "auto", "--chips", "2",
+                          "--requests", "4", "--max-slots", "2"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "plan: auto -> 2x1 (replicas x tp)" in r.stdout, \
+            r.stdout[-2000:]
+        assert r.stdout.count("req ") == 4, r.stdout[-2000:]
+        assert "fleet: replicas=2 ready=2" in r.stdout, \
+            r.stdout[-2000:]
         assert "done: 4 requests" in r.stdout, r.stdout[-2000:]
 
     @pytest.mark.slow

@@ -78,6 +78,11 @@ SUBSET = [
     # REAL per-chip HBM pools and ICI all-reduces — the virtual CPU
     # mesh proves the math, not the placement or the wire
     "tests/test_tp_serving.py",
+    # the planner (ISSUE 15): pure host-side arithmetic, but the
+    # autotune-adoption seam reads the chip's REAL cache entries and
+    # the emitted placements commit onto real devices — cheap to run,
+    # catches a planner/engine key drift on the hardware that matters
+    "tests/test_plan.py",
     "tests/test_chaos.py",
 ]
 
