@@ -464,7 +464,7 @@ class Engine:
             np.int32(-1 if eos_id is None else eos_id),
             np.uint32(seed))
 
-    def step(self) -> Tuple[np.ndarray, np.ndarray]:
+    def step(self) -> Tuple[np.ndarray, np.ndarray]:  # graftlint: hot-step
         """Decode one token for every slot.
 
         Returns ``(tokens, finished)`` — numpy, length ``max_slots``.
@@ -475,6 +475,7 @@ class Engine:
         """
         self.cache, self.state, toks, finished = self._step(
             self._variables, self.cache, self.state)
+        # graftlint: unsharded(the engine's single per-step host sync — the scheduler needs the sampled tokens to route)
         return np.asarray(toks), np.asarray(finished)
 
     def release(self, slot: int) -> None:
@@ -1243,7 +1244,7 @@ class PagedEngine:
                 drafts[slot] = proposal[:cap]
         return drafts
 
-    def step(self) -> StepOutput:
+    def step(self) -> StepOutput:  # graftlint: hot-step
         """One fused mixed prefill+decode step over every slot.
 
         Prefilling tenants consume their next prompt chunk (emitting a
@@ -1297,13 +1298,16 @@ class PagedEngine:
                 self._spec(self._variables, self.cache, self.state,
                            self._tables, self._cursors, feed,
                            n_tokens, emit)
+            # graftlint: unsharded(the paged engine's single per-step host sync — verified drafts steer host-side cursors)
             tokens = np.asarray(sampled)
+            # graftlint: unsharded(same fetch — accepted-prefix lengths roll the cursors back over rejected tails)
             counts = np.asarray(n_emit)
         else:
             runner = self._prefill if any_prefill else self._decode
             self.cache, self.state, toks, finished = runner(
                 self._variables, self.cache, self.state, self._tables,
                 self._cursors, feed, n_tokens, is_prefill, emit)
+            # graftlint: unsharded(the paged engine's single per-step host sync — emitted tokens feed the host tenant table)
             tokens = np.asarray(toks)[:, None]
             counts = emit.astype(np.int32)
         for slot in range(self.max_slots):
@@ -1334,6 +1338,7 @@ class PagedEngine:
                 rec.emitted += kept
                 rec.gen.extend(int(t) for t in tokens[slot, :kept])
             self._cursors[slot] = rec.cursor
+        # graftlint: unsharded(finished flags ride the same per-step fetch; the caller releases finished slots)
         return StepOutput(tokens, np.asarray(finished),
                           counts > 0, tuple(preempted), counts)
 

@@ -26,6 +26,7 @@ from apex_tpu.utils.tracecheck import (
 )
 from apex_tpu.utils import lockcheck
 from apex_tpu.utils import numcheck
+from apex_tpu.utils import shardcheck
 
 __all__ = [
     "is_floating",
@@ -46,4 +47,5 @@ __all__ = [
     "reset_trace_event_count",
     "lockcheck",
     "numcheck",
+    "shardcheck",
 ]

@@ -56,7 +56,7 @@ def bce_logits(logits, target):
         + jnp.log1p(jnp.exp(-jnp.abs(logits))))
 
 
-def main():
+def main():  # graftlint: hot-step
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--batch-size", type=int, default=32)
@@ -128,6 +128,8 @@ def main():
         z = jax.random.normal(jax.random.PRNGKey(i),
                               (args.batch_size, args.zdim))
         g_state, d_state, g_loss, d_loss = step(g_state, d_state, z)
+        # graftlint: unsharded(demo logging — both losses ride one fetch instead of two)
+        g_loss, d_loss = jax.device_get((g_loss, d_loss))
         print(f"step {i:3d}  G {float(g_loss):.4f}  D {float(d_loss):.4f}")
 
 

@@ -89,7 +89,8 @@ from apex_tpu.serving import (
     tp_mesh,
 )
 from apex_tpu.transformer.testing import standalone_gpt
-from apex_tpu.utils import MetricsWriter, lockcheck, numcheck, tracecheck
+from apex_tpu.utils import (MetricsWriter, lockcheck, numcheck,
+                            shardcheck, tracecheck)
 
 pytestmark = [pytest.mark.chaos, pytest.mark.slow]
 
@@ -252,10 +253,16 @@ class TestZeroKillAndResumeTrajectory:
     CKPT_EVERY = 8
 
     @pytest.fixture(autouse=True)
-    def _numcheck_strict(self):
+    def _sanitizers_strict(self):
+        # ISSUE-16: the placement sanitizer rides alongside the
+        # numerics one — the declared ZeRO layout is re-checked
+        # against every compiled step's actual output shardings
         numcheck.reset()
         numcheck.instrument(strict=True)
+        shardcheck.reset()
         yield
+        shardcheck.uninstrument()
+        shardcheck.reset()
         numcheck.uninstrument()
         numcheck.reset()
 
@@ -302,6 +309,18 @@ class TestZeroKillAndResumeTrajectory:
             z_step, mesh=mesh,
             in_specs=(specs, P("data")), out_specs=(specs, P()),
             check_vma=False))
+
+        # runtime placement oracle (ISSUE-16): the step's declared
+        # ZeRO layout — master/moment shards on their mesh rows,
+        # params replicated, pmean'd loss replicated — verified
+        # against the compiled executable's actual outputs every call
+        declared = (jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P)),
+            jax.sharding.NamedSharding(mesh, P()))
+        step = shardcheck.wrap_step(step, declared=declared,
+                                    mesh=mesh, name="zero.train_step",
+                                    strict=True)
 
         def loop_step(state, batch):
             state, loss = step(state, batch)
@@ -386,6 +405,12 @@ class TestZeroKillAndResumeTrajectory:
         hist = numcheck.site_histograms()
         assert set(hist["apply_gradients.master_shards"]) \
             == {"float32"}
+        # ... and the placement oracle: every step of all three runs
+        # actually landed the shards where the ZeRO spec declares
+        shardcheck.assert_clean()
+        zsite = shardcheck.site_shardings()["zero.train_step"]
+        assert zsite["checked"] > 0
+        assert zsite["mismatched"] == 0
 
 
 class TestZeroBenchSmoke:
@@ -528,6 +553,16 @@ class TestMixedPrecisionBenchSmoke:
 
 
 class TestServingChaosSoak:
+    @pytest.fixture(autouse=True)
+    def _shardcheck(self):
+        # ISSUE-16: the soak runs under the strict placement
+        # sanitizer; torn down even on failure so the process-wide
+        # step wrappers and monitoring listener never leak
+        shardcheck.reset()
+        yield
+        shardcheck.uninstrument()
+        shardcheck.reset()
+
     def _tiny(self):
         cfg = GPTConfig.tiny(position_embedding="learned",
                              scan_layers=True)
@@ -545,6 +580,10 @@ class TestServingChaosSoak:
         # (docs/graftlint.md) — instrumented before the worker starts
         lockcheck.reset()
         lockcheck.instrument(server, strict=True)
+        # ... and the strict placement sanitizer on the same server:
+        # single-chip, so no declared layout to verify, but every step
+        # window must stay free of unexpected device-to-host traffic
+        shardcheck.instrument(server, strict=True)
         # transient faults throughout the soak (attempt counter: every
         # 5th decode attempt), plus one admission-path fault
         plan = FaultPlan([
@@ -611,6 +650,11 @@ class TestServingChaosSoak:
         # the strict lock sanitizer observed the whole storm: zero
         # order inversions, zero guarded-field touches without locks
         lockcheck.assert_clean()
+        # ... and the placement sanitizer: the engine's per-step host
+        # sync happens OUTSIDE the compiled-step windows it watched
+        shardcheck.assert_clean()
+        assert shardcheck.site_shardings()["Engine._step"]["calls"] \
+            >= 1
 
     def test_worker_survives_and_serves_after_faults(self):
         """After the fault plan is exhausted the same server keeps
@@ -900,6 +944,15 @@ class TestFleetChaosSoak:
     PAGED_BUDGET = {"decode_step": 1, "prefill_step": 1, "admit": 1,
                     "release": 1}
 
+    @pytest.fixture(autouse=True)
+    def _shardcheck(self):
+        # ISSUE-16: every replica's step windows run under the strict
+        # placement sanitizer for the whole storm
+        shardcheck.reset()
+        yield
+        shardcheck.uninstrument()
+        shardcheck.reset()
+
     def _tiny(self):
         cfg = GPTConfig.tiny(position_embedding="learned",
                              scan_layers=True)
@@ -910,13 +963,15 @@ class TestFleetChaosSoak:
 
     def _factory(self, model, params):
         def factory():
-            # each replica is lock-sanitized as it is built — before
-            # the fleet warms/starts it, so no thread can be inside a
-            # raw critical section at instrumentation time
-            return lockcheck.instrument(InferenceServer(
-                model, params, max_slots=2, kv_cache="paged",
-                block_size=8, pool_tokens=256, prefill_chunk=4),
-                strict=True)
+            # each replica is lock- AND placement-sanitized as it is
+            # built — before the fleet warms/starts it, so no thread
+            # can be inside a raw critical section at instrumentation
+            # time (the same hook covers autoscale replacements)
+            return shardcheck.instrument(lockcheck.instrument(
+                InferenceServer(
+                    model, params, max_slots=2, kv_cache="paged",
+                    block_size=8, pool_tokens=256, prefill_chunk=4),
+                strict=True), strict=True)
         return factory
 
     def _wait_live(self, handles, min_tokens=2, timeout=180.0):
@@ -1019,6 +1074,11 @@ class TestFleetChaosSoak:
         # and the whole storm ran under the strict lock sanitizer:
         # zero order inversions, zero unguarded guarded-field touches
         lockcheck.assert_clean()
+        # ... and the placement sanitizer saw every replica decode
+        # (single-chip fleet: transfer-window accounting) — clean
+        shardcheck.assert_clean()
+        assert shardcheck.site_shardings()[
+            "PagedEngine._decode"]["calls"] >= 1
 
     def test_drain_under_load_is_loss_free(self):
         model, params = self._tiny()
@@ -1069,8 +1129,11 @@ class TestFleetChaosSoak:
                 assert rep.server.engine.blocks_in_use == 0
                 assert rep.server.engine.trace_counts \
                     == self.PAGED_BUDGET
-        # drain + scale-up ran under the strict lock sanitizer too
+        # drain + scale-up ran under the strict lock AND placement
+        # sanitizers too (the scale-up replica enters pre-wrapped
+        # through the factory)
         lockcheck.assert_clean()
+        shardcheck.assert_clean()
 
 
 class TestTPFleetChaosSoak:
@@ -1087,6 +1150,16 @@ class TestTPFleetChaosSoak:
 
     PAGED_BUDGET = {"decode_step": 1, "prefill_step": 1, "admit": 1,
                     "release": 1}
+
+    @pytest.fixture(autouse=True)
+    def _shardcheck(self):
+        # ISSUE-16: the ONE soak where the declared-placement arm of
+        # the sanitizer is live — the TP replica has a committed mesh,
+        # so its pool/state output shardings are verified every step
+        shardcheck.reset()
+        yield
+        shardcheck.uninstrument()
+        shardcheck.reset()
 
     def test_tp_replica_kill_zero_loss_token_identical(self):
         cfg = GPTConfig.tiny(position_embedding="learned",
@@ -1106,10 +1179,11 @@ class TestTPFleetChaosSoak:
             # fleet is the realistic mid-migration state
             i = next(built)
             mesh = tp_mesh(2, jax.devices()[:2]) if i == 0 else None
-            return lockcheck.instrument(InferenceServer(
-                model, params, max_slots=2, kv_cache="paged",
-                block_size=8, pool_tokens=256, prefill_chunk=4,
-                mesh=mesh), strict=True)
+            return shardcheck.instrument(lockcheck.instrument(
+                InferenceServer(
+                    model, params, max_slots=2, kv_cache="paged",
+                    block_size=8, pool_tokens=256, prefill_chunk=4,
+                    mesh=mesh), strict=True), strict=True)
 
         router = FleetRouter(factory, replicas=3, probe_interval=0.05)
         lockcheck.reset()
@@ -1193,3 +1267,14 @@ class TestTPFleetChaosSoak:
                         f"(L={len(p)})")
         assert after == before, "TP fleet kill soak retraced"
         lockcheck.assert_clean()
+        # placement oracle, declared arm live: the TP replica's
+        # sharded pool + replicated state were compared leaf-by-leaf
+        # against the committed layout on every step it served before
+        # the kill — real comparisons (checked > 0), zero mismatches
+        shardcheck.assert_clean()
+        sites = shardcheck.site_shardings()
+        tp_checked = sum(
+            sites.get(f"PagedEngine.{s}", {}).get("checked", 0)
+            for s in ("_decode", "_prefill", "_admit", "_release"))
+        assert tp_checked > 0, \
+            "TP replica served traffic but nothing was checked"

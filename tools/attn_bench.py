@@ -42,7 +42,7 @@ def _overhead():
     return min(dts)
 
 
-def measure(fn, args, iters, overhead, windows=3):
+def measure(fn, args, iters, overhead, windows=3):  # graftlint: hot-step
     @jax.jit
     def many(q, *rest):
         def body(c, _):
@@ -61,10 +61,11 @@ def measure(fn, args, iters, overhead, windows=3):
         return c
 
     out = many(*args)
-    jax.device_get(out)
+    jax.device_get(out)  # graftlint: unsharded(warmup barrier — compile before the timed windows)
     dts = []
     for _ in range(windows):
         t0 = time.perf_counter()
+        # graftlint: unsharded(the fetch IS the measurement barrier; its cost is subtracted as `overhead`)
         jax.device_get(many(*args))
         dts.append(time.perf_counter() - t0)
     return (min(dts) - overhead) / iters

@@ -160,6 +160,7 @@ def _load_rule_modules() -> None:
     from tools.graftlint import concurrency as _conc  # noqa: F401
     from tools.graftlint import precision as _prec  # noqa: F401
     from tools.graftlint import rules as _rules  # noqa: F401
+    from tools.graftlint import sharding as _shard  # noqa: F401
 
 
 def all_rules() -> Dict[str, Rule]:
@@ -880,11 +881,55 @@ def _timing_summary(detail: bool = False) -> str:
     return line
 
 
+#: default on-disk twin of the in-memory AST cache: the (path,
+#: mtime_ns, size) signature of every clean file from the last
+#: ``--changed-only`` run, persisted so LOCAL iteration skips the
+#: full-tree walk.  Full-tree (no flag) remains the CI gate.
+STATE_FILE = ".graftlint_state.json"
+
+
+def _load_state(state_path: str) -> Dict[str, List[int]]:
+    try:
+        with open(state_path, encoding="utf-8") as f:
+            data = json.load(f)
+        files = data.get("files", {})
+        return {str(k): list(v) for k, v in files.items()}
+    except (OSError, ValueError, AttributeError):
+        return {}
+
+
+def _save_state(state_path: str, files: Dict[str, List[int]]) -> None:
+    try:
+        with open(state_path, "w", encoding="utf-8") as f:
+            json.dump({"files": files}, f)
+    except OSError:
+        pass                      # read-only checkout: stay best-effort
+
+
+def _changed_files(paths: Iterable[str], state_path: str
+                   ) -> "tuple[List[str], Dict[str, List[int]]]":
+    """Files under ``paths`` whose (mtime_ns, size) signature differs
+    from the persisted record, plus the fresh signature map."""
+    prev = _load_state(state_path)
+    sigs: Dict[str, List[int]] = {}
+    changed: List[str] = []
+    for path in iter_python_files(paths):
+        key = os.path.abspath(path)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        sigs[key] = [st.st_mtime_ns, st.st_size]
+        if prev.get(key) != sigs[key]:
+            changed.append(path)
+    return changed, sigs
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="graftlint",
-        description="JAX trace-hygiene + concurrency static analyzer "
-                    "(see docs/graftlint.md)")
+        description="JAX trace-hygiene + concurrency + precision + "
+                    "sharding static analyzer (see docs/graftlint.md)")
     parser.add_argument("paths", nargs="*", default=["apex_tpu"],
                         help="files or directories to lint")
     parser.add_argument("--format", choices=("text", "json"),
@@ -896,6 +941,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="print the rule registry and exit")
     parser.add_argument("--timings", action="store_true",
                         help="print the per-rule timing table")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="lint only files whose (path, mtime, "
+                             "size) signature changed since the last "
+                             "--changed-only run (local iteration; "
+                             "whole-program rules see only the "
+                             "changed subset — CI runs the full tree)")
+    parser.add_argument("--state-file", default=None, metavar="PATH",
+                        help=f"--changed-only signature record "
+                             f"(default ./{STATE_FILE})")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -905,11 +959,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{name:26s} [program] {rule.summary}")
         return 0
 
+    state_path = args.state_file or os.path.join(os.getcwd(),
+                                                 STATE_FILE)
     try:
-        findings = lint_paths(args.paths, args.select)
+        targets: List[str] = args.paths
+        sigs: Dict[str, List[int]] = {}
+        if args.changed_only:
+            targets, sigs = _changed_files(args.paths, state_path)
+            if not targets:
+                print("graftlint: 0 changed file(s), clean")
+                _save_state(state_path, sigs)
+                return 0
+        findings = lint_paths(targets, args.select)
     except (FileNotFoundError, ValueError) as exc:
         print(f"graftlint: error: {exc}", file=sys.stderr)
         return 2
+
+    if args.changed_only:
+        # record only files that linted CLEAN: a file with findings
+        # must re-lint next run even if untouched on disk
+        dirty = {os.path.abspath(f.path) for f in findings}
+        _save_state(state_path,
+                    {k: v for k, v in sigs.items() if k not in dirty})
 
     if args.format == "json":
         print(json.dumps([f.to_json() for f in findings], indent=2))

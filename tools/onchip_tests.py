@@ -66,6 +66,11 @@ SUBSET = [
     # unit tier — on chip the fp16 downcast-overflow and underflow
     # paths run against real MXU/VPU rounding, not the CPU emulation
     "tests/test_numcheck.py",
+    # graftlint v4 runtime twin (ISSUE 16): the placement sanitizer's
+    # unit tier — on chip the declared-vs-actual comparisons run
+    # against REAL committed shardings (not the virtual CPU mesh) and
+    # the transfer windows see real device->host DMA, not zero-copy
+    "tests/test_shardcheck.py",
     # ZeRO-1/2 (ISSUE 11): the reduce-scatter/all-gather choreography,
     # the int8 wire leg and the sharded-checkpoint placement must run
     # against REAL ICI collectives and per-device HBM — the virtual
