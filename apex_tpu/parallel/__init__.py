@@ -29,6 +29,21 @@ from apex_tpu.parallel.distributed_optim import (
     zero_state_specs,
     zero_unpartition,
 )
+from apex_tpu.parallel.pipeline import (
+    bubble_fraction,
+    live_microbatches,
+    pipeline_state_shardings,
+    pipeline_state_specs,
+    run_1f1b,
+    schedule_ticks,
+    stage_local_zero,
+    stage_shardings,
+    stage_specs,
+    stage_split,
+    stage_unsplit,
+    sync_grad_overflow,
+    wrap_pipeline_step,
+)
 from apex_tpu.parallel.ring_attention import (
     ring_attention,
     ring_self_attention,
@@ -54,6 +69,11 @@ __all__ = [
     "zero_partition", "zero_unpartition",
     "reduce_scatter_mean_grads", "all_gather_params",
     "zero_param_specs", "zero_shardings", "zero_state_specs",
+    "bubble_fraction", "schedule_ticks", "live_microbatches",
+    "stage_split", "stage_unsplit", "stage_specs", "stage_shardings",
+    "stage_local_zero", "pipeline_state_specs",
+    "pipeline_state_shardings", "sync_grad_overflow",
+    "run_1f1b", "wrap_pipeline_step",
     "ring_attention", "ring_self_attention",
     "ulysses_attention", "ulysses_self_attention",
     "LARC",

@@ -39,7 +39,11 @@ Four stages, one module each:
 - :mod:`~apex_tpu.plan.emit` — the winner as a
   ``jax.sharding.Mesh`` + PartitionSpec surfaces
   (``zero_state_specs`` / ``paged_pool_shardings`` / GSPMD layer
-  annotations), all delegated to the existing library machinery.
+  annotations), all delegated to the existing library machinery;
+- :mod:`~apex_tpu.plan.calibrate` — a *measured*
+  :class:`~apex_tpu.plan.score.HardwareSpec` from short on-device
+  micro-sweeps (``apex_tpu.plan(cfg, hardware=plan.calibrate())``;
+  falls back to the bench-constant defaults off-accelerator).
 
 See ``docs/planner.md`` for the worked example and the cost-model
 seams.
@@ -52,6 +56,7 @@ import types
 from typing import Any, Dict, Optional, Sequence, Union
 
 from apex_tpu.plan import costs
+from apex_tpu.plan.calibrate import calibrate
 from apex_tpu.plan.emit import Plan, emit_plan, model_param_specs
 from apex_tpu.plan.enumerate import (
     InfeasibleError,
@@ -78,6 +83,7 @@ __all__ = [
     "ModelProfile",
     "HardwareSpec",
     "DEFAULT_HW",
+    "calibrate",
     "InfeasibleError",
     "profile_of",
     "generic_profile",
@@ -114,9 +120,11 @@ def plan(model_cfg: Any,
          objective: str = "train",
          slo: Optional[Dict[str, float]] = None, *,
          hw: Optional[HardwareSpec] = None,
+         hardware: Optional[HardwareSpec] = None,
          batch_per_chip: int = 1,
          seq: Optional[int] = None,
          slots: int = 8,
+         microbatches: int = 8,
          live_tokens: Optional[int] = None,
          cost_seed: Optional[Dict[str, float]] = None) -> Plan:
     """Plan the parallel layout of ``model_cfg`` over ``devices``.
@@ -134,11 +142,17 @@ def plan(model_cfg: Any,
     none survive, listing the modeled TTFT per layout).
     ``hw`` — per-chip peaks + HBM budget
     (:class:`~apex_tpu.plan.score.HardwareSpec`;
-    the bench harness's assumed peaks by default).
+    the bench harness's assumed peaks by default).  ``hardware`` is
+    an alias for ``hw`` that reads naturally with the measured spec:
+    ``apex_tpu.plan(cfg, hardware=plan.calibrate())``
+    (:mod:`apex_tpu.plan.calibrate`; passing both is an error).
     ``batch_per_chip``/``seq`` (train) and ``slots``/``live_tokens``
     (serve) size the activation/KV columns of the feasibility pruning
-    and the roofline.  ``cost_seed`` — anchor the MXU/HBM terms in a
-    compiled step's XLA cost analysis
+    and the roofline.  ``microbatches`` (train) — the per-step 1F1B
+    count pipelined (``pipe > 1``) layouts run with: the bubble
+    (p−1)/m denominator, the ``pipe <= microbatches`` gate, and the
+    ≤p live-activation residency scale.  ``cost_seed`` — anchor the
+    MXU/HBM terms in a compiled step's XLA cost analysis
     (:func:`~apex_tpu.plan.score.xla_cost_seed`) instead of the
     analytic estimates, the way the bench legs seed their rooflines.
 
@@ -147,7 +161,10 @@ def plan(model_cfg: Any,
     binding constraint per pruned layout when *no* layout fits the
     per-chip HBM budget.
     """
-    hw = hw or DEFAULT_HW
+    if hw is not None and hardware is not None:
+        raise ValueError(
+            "pass hw= or hardware= (they are aliases), not both")
+    hw = hw or hardware or DEFAULT_HW
     devs = _resolve_devices(devices)
     profile = profile_of(model_cfg)
     # objective-mismatched knobs fail loudly instead of being
@@ -194,11 +211,13 @@ def plan(model_cfg: Any,
     else:
         kept = feasible_layouts(
             profile, len(devs), objective, hbm_bytes=hw.hbm_bytes,
-            batch_per_chip=batch_per_chip, seq=seq, slots=slots)
+            batch_per_chip=batch_per_chip, seq=seq, slots=slots,
+            microbatches=microbatches)
         scores = [
             score_layout(profile, layout, hw=hw,
                          batch_per_chip=batch_per_chip, seq=seq,
                          slots=slots, live_tokens=live_tokens,
+                         microbatches=microbatches,
                          cost_seed=cost_seed, slo=slo, residency=comp)
             for layout, comp in kept]
     if objective == "serve" and slo and "ttft_ms" in slo:

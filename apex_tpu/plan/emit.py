@@ -27,7 +27,7 @@ throughout): a plan is a value the caller commits, not ambient state.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
@@ -86,6 +86,12 @@ class Plan:
     zero: Any = None                        # ZeroConfig | None
     param_specs: Any = None                 # GSPMD annotations | None
     data_spec: PartitionSpec = PartitionSpec()
+    # pipeline (layout.pipe > 1): the per-step 1F1B microbatch count
+    # the layout was scored with, and the contiguous layer range
+    # [start, stop) each stage owns — what the caller stage_split()s
+    # the layer stack by
+    microbatches: int = 0
+    stage_assignment: Optional[List[Tuple[int, int]]] = None
     # serving split
     replicas: int = 1
     tp: int = 1
@@ -107,25 +113,52 @@ class Plan:
         """``shard_map`` in/out PartitionSpecs for a train state built
         with this plan's ``zero`` config — the existing
         :func:`~apex_tpu.parallel.distributed_optim.zero_state_specs`
-        (replicated leaves when the plan is not ZeRO-sharded)."""
-        from apex_tpu.parallel import zero_state_specs
+        (replicated leaves when the plan is not ZeRO-sharded).  A
+        pipelined zero plan (``layout.pipe > 1``) expects the state to
+        have gone through :func:`~apex_tpu.parallel.pipeline.
+        stage_local_zero` and delegates to
+        :func:`~apex_tpu.parallel.pipeline.pipeline_state_specs`
+        (stage-stacked leaves on the pipe axis, masters/moments
+        stage-local over the data axis)."""
+        from apex_tpu.parallel import (
+            pipeline_state_specs,
+            zero_state_specs,
+        )
 
         if self.zero is not None:
+            if self.layout.pipe > 1:
+                return pipeline_state_specs(state)
             return zero_state_specs(state)
+        if self.layout.pipe > 1:
+            # plain (non-ZeRO) pipelined state: stage-stacked leaves
+            # — params and the moments initialized from them — on the
+            # pipe axis, scalars replicated
+            from apex_tpu.parallel.pipeline import _plain_state_specs
+
+            return _plain_state_specs(state, self.layout.pipe)
         return jax.tree.map(lambda _: PartitionSpec(), state)
 
     def state_shardings(self, state: Any) -> Any:
         """Committed ``NamedSharding`` placement for the train state —
         :func:`~apex_tpu.parallel.distributed_optim.zero_shardings`
-        over this plan's mesh for a zero state, replicated otherwise.
-        Doubles as the checkpoint-restore target, exactly like the
-        hand-written ``--zero`` example path."""
-        from apex_tpu.parallel import zero_shardings
+        over this plan's mesh for a zero state
+        (:func:`~apex_tpu.parallel.pipeline.pipeline_state_shardings`
+        when the plan pipelines), replicated otherwise.  Doubles as
+        the checkpoint-restore target, exactly like the hand-written
+        ``--zero`` example path."""
+        from apex_tpu.parallel import (
+            pipeline_state_shardings,
+            zero_shardings,
+        )
 
         if self.zero is not None:
+            if self.layout.pipe > 1:
+                return pipeline_state_shardings(state, mesh=self.mesh)
             return zero_shardings(state, mesh=self.mesh)
         return jax.tree.map(
-            lambda _: NamedSharding(self.mesh, PartitionSpec()), state)
+            lambda s: NamedSharding(self.mesh, s),
+            self.state_specs(state),
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
 
     # -------------------------------------------------- serve surfaces
 
@@ -165,10 +198,14 @@ def _zero_config(layout: Layout):
 
 def emit_plan(model_cfg: Any, layout: Layout,
               devices: Sequence[Any], score: Dict[str, Any],
-              alternatives: List[Dict[str, Any]]) -> Plan:
+              alternatives: List[Dict[str, Any]], *,
+              microbatches: Optional[int] = None) -> Plan:
     """Build the :class:`Plan` for a chosen layout (the last stage of
     ``apex_tpu.plan()``; callable directly to materialize a hand-picked
-    :class:`~apex_tpu.plan.enumerate.Layout`)."""
+    :class:`~apex_tpu.plan.enumerate.Layout`).  ``microbatches``
+    records the 1F1B count a pipelined layout was scored with
+    (defaults to the score's own; pipelined layouts also get a
+    ``stage_assignment`` — the contiguous layer range per stage)."""
     profile = profile_of(model_cfg)
     devices = list(devices)
     if layout.chips != len(devices):
@@ -192,6 +229,7 @@ def emit_plan(model_cfg: Any, layout: Layout,
                     engine_kwargs=kwargs, replica_devices=slices)
     mesh = mesh_lib.initialize_mesh(
         tensor_model_parallel_size=layout.tp,
+        pipeline_model_parallel_size=layout.pipe,
         context_parallel_size=layout.cp,
         data_parallel_size=layout.dp,
         devices=devices, set_current=False)
@@ -199,7 +237,18 @@ def emit_plan(model_cfg: Any, layout: Layout,
              if profile.kind == "transformer" else None)
     data_spec = (PartitionSpec(DATA_AXIS, CONTEXT_AXIS)
                  if layout.cp > 1 else PartitionSpec(DATA_AXIS))
+    mb = microbatches if microbatches is not None else \
+        int(score.get("microbatches", 0))
+    assignment = None
+    if layout.pipe > 1:
+        # contiguous balanced split — the same carve stage_split()
+        # applies to a stacked layer tree (the enumeration gate
+        # guarantees divisibility)
+        per = profile.num_layers // layout.pipe
+        assignment = [(s * per, (s + 1) * per)
+                      for s in range(layout.pipe)]
     return Plan(objective="train", layout=layout, profile=profile,
                 mesh=mesh, score=score, alternatives=alternatives,
                 devices=devices, zero=_zero_config(layout),
-                param_specs=specs, data_spec=data_spec)
+                param_specs=specs, data_spec=data_spec,
+                microbatches=mb, stage_assignment=assignment)

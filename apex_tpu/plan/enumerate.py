@@ -211,16 +211,22 @@ def profile_of(model_cfg: Any) -> ModelProfile:
 
 
 def generic_profile(n_params: int, *, dtype_bytes: int = 4,
-                    act_bytes_per_sample: int = 0) -> ModelProfile:
+                    act_bytes_per_sample: int = 0,
+                    num_layers: int = 0) -> ModelProfile:
     """Profile an arbitrary model by parameter count alone — the
     data-parallel-only escape hatch (no tensor/context sharding is
     enumerated because the planner knows nothing about the
     architecture).  ``act_bytes_per_sample`` feeds the activation
-    residency column (0 = negligible, fine for small nets)."""
+    residency column (0 = negligible, fine for small nets).
+    ``num_layers`` declares a homogeneous stacked-layer depth, which
+    unlocks the ``pipe`` degree: pipeline stages need a layer stack
+    to split (``num_layers % pipe == 0``), and a model that declares
+    none stays un-pipelined."""
     return ModelProfile(kind="generic", n_params=int(n_params),
                         dtype_bytes=int(dtype_bytes),
                         dtype_name={2: "bfloat16", 4: "float32"}.get(
                             int(dtype_bytes), "float32"),
+                        num_layers=int(num_layers),
                         act_bytes_per_sample=int(act_bytes_per_sample))
 
 
@@ -228,24 +234,28 @@ def generic_profile(n_params: int, *, dtype_bytes: int = 4,
 class Layout:
     """One point of the decision space.
 
-    Train: ``dp × cp × tp`` mesh degrees + ZeRO stage/wire; serve:
-    ``dp`` is the replica count and ``tp`` the chips per replica
-    (``cp``/``zero_stage`` stay at their neutral values).  ``attn`` is
-    the context-sharded attention implementation (``"local"`` when
-    ``cp == 1``).
+    Train: ``dp × cp × tp × pipe`` mesh degrees + ZeRO stage/wire;
+    serve: ``dp`` is the replica count and ``tp`` the chips per
+    replica (``cp``/``pipe``/``zero_stage`` stay at their neutral
+    values).  ``attn`` is the context-sharded attention
+    implementation (``"local"`` when ``cp == 1``).  ``pipe`` is the
+    1F1B pipeline-stage count (:mod:`apex_tpu.parallel.pipeline`);
+    a pipelined layout runs ``microbatches`` microbatches per step
+    and pays the (p−1)/m bubble the scorer models.
     """
 
     objective: str = "train"         # "train" | "serve"
     dp: int = 1
     cp: int = 1
     tp: int = 1
+    pipe: int = 1                    # 1F1B stage count
     zero_stage: int = 0              # 0 | 1 | 2
     reduce_dtype: Optional[str] = None   # None(fp32) | "bf16" | "int8"
     attn: str = "local"              # "local" | "ring" | "ulysses"
 
     @property
     def chips(self) -> int:
-        return self.dp * self.cp * self.tp
+        return self.dp * self.cp * self.tp * self.pipe
 
     def describe(self) -> str:
         if self.objective == "serve":
@@ -255,6 +265,8 @@ class Layout:
             bits.append(f"cp={self.cp}({self.attn})")
         if self.tp > 1:
             bits.append(f"tp={self.tp}")
+        if self.pipe > 1:
+            bits.append(f"pipe={self.pipe}")
         if self.zero_stage:
             wire = self.reduce_dtype or "fp32"
             bits.append(f"zero{self.zero_stage}/{wire}")
@@ -288,6 +300,28 @@ def _tp_ok(profile: ModelProfile, tp: int) -> bool:
     return True
 
 
+def _pipe_ok(profile: ModelProfile, pipe: int,
+             microbatches: int) -> bool:
+    """Config-time gates of the ``pipe`` degree — the same contracts
+    :func:`apex_tpu.parallel.pipeline.stage_split` and the 1F1B
+    schedule enforce at trace time:
+
+    - **stage balance / layer divisibility**: stages split a
+      homogeneous layer stack, so the model must declare one
+      (``num_layers > 0``) and it must divide evenly
+      (``num_layers % pipe == 0`` — ``stage_split`` raises otherwise);
+    - **microbatch floor**: the 1F1B steady state needs at least one
+      microbatch per stage (``pipe <= microbatches``; below that the
+      "bubble" exceeds the work and the live-activation bound p is
+      never reached anyway).
+    """
+    if pipe == 1:
+        return True
+    if profile.num_layers < 1 or profile.num_layers % pipe:
+        return False
+    return pipe <= int(microbatches)
+
+
 def _attn_impls(profile: ModelProfile, cp: int,
                 seq: Optional[int] = None) -> List[str]:
     """Context-sharded attention implementations legal at degree
@@ -310,11 +344,15 @@ def _attn_impls(profile: ModelProfile, cp: int,
 
 def enumerate_layouts(profile: ModelProfile, n_devices: int,
                       objective: str = "train", *,
-                      seq: Optional[int] = None) -> List[Layout]:
+                      seq: Optional[int] = None,
+                      microbatches: int = 8) -> List[Layout]:
     """Every gate-passing layout for ``n_devices`` chips (no HBM
     pruning — that is :func:`feasible_layouts`' job).  ``seq`` is the
     sequence length the caller trains at (the ring gate's
-    divisibility operand); defaults to the config's ``max_seq_len``."""
+    divisibility operand); defaults to the config's ``max_seq_len``.
+    ``microbatches`` is the per-step 1F1B microbatch count pipelined
+    layouts would run with — the ``pipe <= microbatches`` gate's
+    operand and the (p−1)/m bubble's denominator downstream."""
     n = int(n_devices)
     if n < 1:
         raise ValueError(f"n_devices must be >= 1, got {n}")
@@ -335,21 +373,25 @@ def enumerate_layouts(profile: ModelProfile, n_devices: int,
             out.append(Layout(objective="serve", dp=n // tp, tp=tp))
         return out
     for dp in _divisors(n):
-        for cp in _divisors(n // dp):
-            tp = n // (dp * cp)
-            if not _tp_ok(profile, tp):
+        for pipe in _divisors(n // dp):
+            if not _pipe_ok(profile, pipe, microbatches):
                 continue
-            for attn in _attn_impls(profile, cp, seq):
-                for stage in (0, 1, 2):
-                    if stage and dp < 2:
-                        continue       # nothing to shard over
-                    wires = ([None] if stage == 0
-                             else [None, "bf16", "int8"])
-                    for wire in wires:
-                        out.append(Layout(
-                            objective="train", dp=dp, cp=cp, tp=tp,
-                            zero_stage=stage, reduce_dtype=wire,
-                            attn=attn))
+            for cp in _divisors(n // (dp * pipe)):
+                tp = n // (dp * pipe * cp)
+                if not _tp_ok(profile, tp):
+                    continue
+                for attn in _attn_impls(profile, cp, seq):
+                    for stage in (0, 1, 2):
+                        if stage and dp < 2:
+                            continue       # nothing to shard over
+                        wires = ([None] if stage == 0
+                                 else [None, "bf16", "int8"])
+                        for wire in wires:
+                            out.append(Layout(
+                                objective="train", dp=dp, cp=cp,
+                                tp=tp, pipe=pipe,
+                                zero_stage=stage, reduce_dtype=wire,
+                                attn=attn))
     return out
 
 
@@ -364,6 +406,7 @@ def memory_model(profile: ModelProfile, layout: Layout, *,
                  pool_tokens: Optional[int] = None,
                  block_size: int = 16,
                  kv_dtype: Optional[str] = None,
+                 microbatches: int = 8,
                  opt_bytes_per_param: int = _OPT_BYTES_PER_PARAM
                  ) -> Dict[str, int]:
     """Per-chip HBM residency of ``layout`` — the pruning columns.
@@ -376,6 +419,18 @@ def memory_model(profile: ModelProfile, layout: Layout, *,
     stage 2), ``activations`` (rematted-residual estimate calibrated
     against the llama_1b bench temp row) and ``logits`` (the CE
     residual, vocab-sharded under tp).
+
+    Under ``layout.pipe > 1`` the columns become PER-STAGE residency
+    (the pipeline tentpole's HBM lever): a stage holds ``1/pipe`` of
+    the params — and of the optimizer state and gradient buffers,
+    sharded further over the stage's own data replicas by ZeRO — while
+    the 1F1B schedule keeps at most ``pipe`` of the ``microbatches``
+    microbatch activation sets live per stage, so the activation
+    column scales by ``min(pipe, m)/m``.  (The per-stage layer count
+    ``L/pipe`` and the per-replica batch ``batch_per_chip × pipe``
+    cancel, so only the live-microbatch fraction appears.)  The CE
+    residual shrinks to one live microbatch on the last stage: 1F1B
+    runs each microbatch's loss backward the tick after its forward.
 
     Serve components: ``params`` (bf16 inference replica / tp),
     ``kv_pool`` (the :func:`kv_store_bytes_per_token` capacity formula
@@ -397,10 +452,17 @@ def memory_model(profile: ModelProfile, layout: Layout, *,
         comp["logits"] = int(slots * profile.vocab_size * 4 / tp)
     else:
         s = seq or profile.max_seq_len or 1
-        comp["params"] = int(n * profile.dtype_bytes / tp)
+        # per-stage model slice: each pipeline stage holds 1/pipe of
+        # the layer stack's params (+ their optimizer state + grads)
+        n_stage = n / layout.pipe
+        m = max(int(microbatches), 1)
+        # ≤ pipe of the m microbatch activation sets are live at the
+        # 1F1B steady state (warmup fills to p, drain empties)
+        live_frac = min(layout.pipe, m) / m if layout.pipe > 1 else 1.0
+        comp["params"] = int(n_stage * profile.dtype_bytes / tp)
         if layout.zero_stage:
             zm = costs.zero_bytes_on_wire(
-                n / tp, layout.dp, stage=layout.zero_stage,
+                n_stage / tp, layout.dp, stage=layout.zero_stage,
                 param_bytes=profile.dtype_bytes,
                 opt_bytes_per_param=opt_bytes_per_param)
             # the zero residency already counts the param replica —
@@ -409,18 +471,26 @@ def memory_model(profile: ModelProfile, layout: Layout, *,
                 zm["model_state_bytes_per_chip_zero"]
                 - comp["params"])
         else:
-            comp["optimizer_state"] = int(opt_bytes_per_param * n / tp)
+            comp["optimizer_state"] = int(
+                opt_bytes_per_param * n_stage / tp)
         grad_shards = layout.dp if layout.zero_stage == 2 else 1
-        comp["gradients"] = int(4 * n / tp / grad_shards)
+        comp["gradients"] = int(4 * n_stage / tp / grad_shards)
         if profile.kind == "transformer":
+            # batch_per_chip × pipe samples flow through each replica
+            # pipeline, over L/pipe layers per stage — the two pipe
+            # factors cancel, leaving the live-microbatch fraction
             comp["activations"] = int(
                 _ACT_BYTES_PER_TOKEN_HIDDEN_LAYER * batch_per_chip
                 * s * profile.hidden_size * profile.num_layers
-                / (layout.cp * tp))
+                * live_frac / (layout.cp * tp))
             # fp32 CE residual over the (b, s, vocab) logits — the
             # sequence axis shards on context, the vocab axis on
-            # tensor, so both degrees divide the per-chip residual
-            comp["logits"] = int(4 * batch_per_chip * s
+            # tensor, so both degrees divide the per-chip residual;
+            # under pipe only ONE microbatch's logits are live on the
+            # last stage (its loss backward runs the next tick)
+            logit_b = (batch_per_chip if layout.pipe == 1
+                       else batch_per_chip * layout.pipe / m)
+            comp["logits"] = int(4 * logit_b * s
                                  * profile.vocab_size
                                  / (layout.cp * tp))
         elif profile.kind == "resnet":
@@ -429,7 +499,7 @@ def memory_model(profile: ModelProfile, layout: Layout, *,
                 * profile.dtype_bytes * 2)   # residents + grad mirror
         else:
             comp["activations"] = int(profile.act_bytes_per_sample
-                                      * batch_per_chip)
+                                      * batch_per_chip * live_frac)
     comp["total"] = sum(comp.values())
     return comp
 
@@ -456,10 +526,13 @@ def feasible_layouts(profile: ModelProfile, n_devices: int,
     ``plan()`` uses it to judge each serving split on the SAME
     autotuned pool its score (and emitted engine kwargs) adopt.
     Raises :class:`InfeasibleError` (with the per-layout binding
-    constraint) when nothing survives."""
+    constraint) when nothing survives.  A ``microbatches`` entry in
+    the memory-model kwargs doubles as the pipe-degree gate operand
+    (``pipe <= microbatches``)."""
     profile = profile_of(profile)
-    layouts = enumerate_layouts(profile, n_devices, objective,
-                                seq=seq)
+    layouts = enumerate_layouts(
+        profile, n_devices, objective, seq=seq,
+        microbatches=mm_kwargs.get("microbatches", 8))
     kept, pruned = [], []
     for layout in layouts:
         kw = dict(mm_kwargs)

@@ -132,7 +132,13 @@ def autotuned_paged_layout(profile: ModelProfile,
 def _train_flops_per_chip(profile: ModelProfile, layout: Layout,
                           batch_per_chip: int, seq: int) -> float:
     """fwd+bwd FLOPs per chip per step: the 6·P-per-token dense term
-    + the causal flash-attention term (windowed where the model is)."""
+    + the causal flash-attention term (windowed where the model is).
+
+    Pipe-invariant by construction: a pipelined replica pushes
+    ``batch_per_chip × pipe`` samples through stages holding
+    ``n_params / pipe`` each, so per-chip work matches the
+    un-pipelined layout at the same chip count — the bubble (idle
+    time), not extra work, is where pipe pays."""
     tokens_per_chip = batch_per_chip * seq
     dense = 6.0 * profile.n_params * tokens_per_chip \
         / (layout.cp * layout.tp)
@@ -155,7 +161,8 @@ def score_layout(profile: ModelProfile, layout: Layout, *,
                  cost_seed: Optional[Dict[str, float]] = None,
                  slo: Optional[Dict[str, float]] = None,
                  tuned: Optional[Dict[str, Any]] = None,
-                 residency: Optional[Dict[str, int]] = None
+                 residency: Optional[Dict[str, int]] = None,
+                 microbatches: int = 8
                  ) -> Dict[str, Any]:
     """Roofline-score one layout; higher ``value`` is better.
 
@@ -168,6 +175,8 @@ def score_layout(profile: ModelProfile, layout: Layout, *,
     :func:`~apex_tpu.plan.enumerate.memory_model` breakdown the
     caller already computed (``plan()`` passes the feasibility pass's
     own — the pruning and the reported residency can never diverge).
+    ``microbatches`` is the per-step 1F1B count of pipelined layouts —
+    the (p−1)/m bubble's denominator (ignored at ``pipe == 1``).
     """
     profile = profile_of(profile)
     if layout.objective == "serve":
@@ -176,12 +185,16 @@ def score_layout(profile: ModelProfile, layout: Layout, *,
     seq = seq or profile.max_seq_len or 1
     comp = residency or memory_model(
         profile, layout, batch_per_chip=batch_per_chip, seq=seq,
-        slots=slots)
+        slots=slots, microbatches=microbatches)
     if cost_seed:
         # the seed describes the SINGLE-CHIP step: each layout's
         # model-sharding degree divides its per-chip work (without
         # this every layout would score an identical roofline and the
-        # ranking would degenerate to max-dp)
+        # ranking would degenerate to max-dp).  pipe does NOT divide
+        # the seed: a stage runs 1/pipe of the model over pipe× the
+        # samples — per-chip work is pipe-invariant (see
+        # _train_flops_per_chip); the bubble multiplier below carries
+        # the pipeline's cost instead
         shard = layout.cp * layout.tp
         flops = cost_seed["flops"] / shard
         hbm_bytes = cost_seed["bytes_accessed"] / shard
@@ -191,15 +204,27 @@ def score_layout(profile: ModelProfile, layout: Layout, *,
         # per-step streaming: params read fwd+bwd, fp32 master/moment
         # read+write around the update, grads written+read, plus the
         # calibrated activation working set streamed ~once each way
+        acts = comp.get("activations", 0)
+        logits = comp.get("logits", 0)
+        if layout.pipe > 1:
+            # the residency columns hold only the ≤p LIVE microbatch
+            # sets (and one live logit microbatch); the per-step
+            # STREAM is all m of them — which lands back exactly on
+            # the un-pipelined per-chip traffic (pipe factors cancel)
+            m = max(int(microbatches), 1)
+            acts = acts * m / min(layout.pipe, m)
+            logits = logits * m / layout.pipe
         hbm_bytes = (2.0 * comp["params"]
                      + 2.5 * comp["optimizer_state"]
                      + 2.0 * comp["gradients"]
-                     + 2.0 * comp.get("activations", 0)
-                     + 2.0 * comp.get("logits", 0))
+                     + 2.0 * acts
+                     + 2.0 * logits)
     t_mxu = flops / (hw.peak_tflops * 1e12)
     t_hbm = hbm_bytes / (hw.peak_hbm_gbs * 1e9)
-    # grad-sync wire per step (the data axis)
-    shard_params = profile.n_params / (layout.cp * layout.tp)
+    # grad-sync wire per step (the data axis) — a pipelined layout
+    # reduces only its stage's grads over the stage's data replicas
+    shard_params = profile.n_params / (layout.cp * layout.tp
+                                       * layout.pipe)
     if layout.dp > 1:
         if layout.zero_stage:
             zw = costs.zero_bytes_on_wire(
@@ -229,11 +254,31 @@ def score_layout(profile: ModelProfile, layout: Layout, *,
                   * profile.head_dim * 2 * profile.dtype_bytes)
             wire += (3 * profile.num_layers * kv
                      * (layout.cp - 1) / layout.cp)
+    # the stage-boundary activation column: every microbatch's
+    # activations ppermute forward across p−1 boundaries and the
+    # cotangents mirror them backward (costs.pipeline_costs)
+    pipe_costs = None
+    if layout.pipe > 1:
+        m = max(int(microbatches), 1)
+        mb_tokens = round(batch_per_chip * layout.pipe * seq
+                          / m / layout.cp)
+        pipe_costs = costs.pipeline_costs(
+            layout.pipe, m,
+            microbatch_tokens=mb_tokens,
+            hidden_size=profile.hidden_size,
+            dtype_bytes=profile.dtype_bytes)
+        wire += pipe_costs["boundary_bytes_per_step_per_chip"]
     t_ici = wire / (hw.peak_ici_gbs * 1e9)
-    step = max(t_mxu, t_hbm) + t_ici
-    global_samples = batch_per_chip * layout.dp
+    # the 1F1B bubble stretches the compute-bound portion of the step
+    # by (p−1)/m — warmup/drain idle, first-class in the score
+    bubble = (pipe_costs or {}).get("bubble_fraction", 0.0)
+    step = max(t_mxu, t_hbm) * (1.0 + bubble) + t_ici
+    # a pipelined replica spans pipe chips and carries pipe× the
+    # per-chip batch — samples/sec/chip stays comparable across pipe
+    # degrees at equal chips
+    global_samples = batch_per_chip * layout.dp * layout.pipe
     value = global_samples / step / layout.chips
-    return {
+    out = {
         "objective": "train",
         "layout": layout,
         "value": value,
@@ -248,6 +293,11 @@ def score_layout(profile: ModelProfile, layout: Layout, *,
         "wire_bytes_per_step": int(wire),
         "cost_seed": cost_seed,
     }
+    if pipe_costs is not None:
+        out["pipeline"] = pipe_costs
+        out["bubble_fraction"] = bubble
+        out["microbatches"] = int(microbatches)
+    return out
 
 
 def _score_serve(profile: ModelProfile, layout: Layout,

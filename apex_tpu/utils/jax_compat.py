@@ -71,6 +71,15 @@ def _shard_map_fallback(f=None, *, mesh=None, in_specs=None,
                **kw)
 
 
+def _pcast_fallback(x, *args, **kw):
+    """``lax.pcast`` for jax builds that predate varying-manual-axes
+    tracking: the op is metadata-only (it marks a value device-varying
+    for the vma checker), so on a jax with no vma tracking the
+    identity is semantics-equivalent."""
+    del args, kw
+    return x
+
+
 def axis_size(axis_name):
     """The current-jax ``lax.axis_size`` regardless of version."""
     if hasattr(lax, "axis_size"):
@@ -90,5 +99,7 @@ def install() -> None:
     running jax lacks them (no-op otherwise)."""
     if not hasattr(lax, "axis_size"):
         lax.axis_size = _axis_size_fallback
+    if not hasattr(lax, "pcast"):
+        lax.pcast = _pcast_fallback
     if not hasattr(jax, "shard_map"):
         jax.shard_map = _shard_map_fallback

@@ -3540,6 +3540,247 @@ def bench_tp_serving():
 
 # ----------------------------------------------------------------- driver
 
+# ----------------------------------------------------- pipeline 1F1B
+
+
+def bench_pipeline_train():
+    """Measured ISSUE-20 row: dp baseline vs dp × pipe at EQUAL chips.
+
+    Two arms over the same global batch and the same stacked
+    residual-MLP layer stack (the pipeline test suite's workload):
+
+    - ``dp`` — pure data parallelism over all chips, replicated
+      params/optimizer: the layout the planner falls back FROM when
+      per-chip residency busts the HBM budget.
+    - ``dp_pipe`` — ``parallel.pipeline`` end-to-end: ``stage_split``
+      over ``pipe=BENCH_PIPE_PP``, stage-local ZeRO-2 over the
+      remaining ``data`` axis, 1F1B via ``wrap_pipeline_step``.
+
+    Emits samples/sec/chip for both arms, the XLA memory-analysis
+    per-chip (= per-stage × dp-shard) HBM plus exact placed-array
+    state bytes, and measured-vs-modeled bubble: the pipe arm runs at
+    two microbatch counts (m, 2m) so the per-microbatch time
+    ``τ = (t(2m) − t(m)) / m`` factors out the fixed overhead;
+    ``measured_bubble = (t(m) − m·τ) / (m·τ)`` is pinned against the
+    schedule's ``(p−1)/m`` and the ``plan.costs.pipeline_costs``
+    block the planner scores with.  On the CPU mesh τ prices compute,
+    not the overlapped ppermute wire, so the comparison is
+    report-only unless ``BENCH_PIPE_BUBBLE_BAND`` is set (> 0:
+    ``|measured − modeled|`` must land inside the band).
+
+    Env: BENCH_PIPE_PP (2), BENCH_PIPE_LAYERS (8), BENCH_PIPE_HIDDEN
+    (64), BENCH_PIPE_MB (8), BENCH_PIPE_MICROBATCHES (8),
+    BENCH_PIPE_STEPS (8), BENCH_PIPE_BUBBLE_BAND (0 = report-only).
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import amp
+    from apex_tpu.optim import fused_adam
+    from apex_tpu.parallel import ZeroConfig
+    from apex_tpu.parallel import pipeline as pl
+    from apex_tpu.plan.costs import pipeline_costs
+
+    n_dev = jax.device_count()
+    pp = int(os.environ.get("BENCH_PIPE_PP", "2"))
+    if n_dev < 2 or pp < 2 or n_dev % pp:
+        _emit({"metric": "pipeline_train", "value": None,
+               "skipped": (f"needs device_count % pp == 0 with "
+                           f"pp >= 2, have {n_dev} devices, pp={pp}")})
+        return
+    dp = n_dev // pp
+    layers = int(os.environ.get("BENCH_PIPE_LAYERS", "8"))
+    layers = max(pp, layers - layers % pp)      # stage-balance gate
+    hid = int(os.environ.get("BENCH_PIPE_HIDDEN", "64"))
+    mb = int(os.environ.get("BENCH_PIPE_MB", "8"))
+    m = int(os.environ.get("BENCH_PIPE_MICROBATCHES", "8"))
+    m = max(pp, m - m % pp)                     # m >= p, DP-divisible
+    steps = int(os.environ.get("BENCH_PIPE_STEPS", "8"))
+    lr = 1e-2
+
+    r = np.random.default_rng(0)
+    params = {"stages": (
+        jnp.asarray(r.normal(size=(layers, hid, hid)) * 0.3,
+                    jnp.float32),
+        jnp.asarray(r.normal(size=(layers, hid)) * 0.1, jnp.float32),
+        jnp.asarray(r.normal(size=(layers, hid, hid)) * 0.3,
+                    jnp.float32),
+    )}
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+
+    def layer(x, args):
+        w1, b1, w2 = args
+        h = jnp.tanh(x @ w1 + b1)
+        return x + h @ w2, None
+
+    def stage_fn(stage_params, x):
+        x, _ = jax.lax.scan(layer, x, stage_params)
+        return x
+
+    def batch_of(mm):
+        rb = np.random.default_rng(1)
+        x = jnp.asarray(rb.normal(size=(dp * mm, mb, hid)),
+                        jnp.float32)
+        y = jnp.asarray(rb.normal(size=(dp * mm, mb, hid)),
+                        jnp.float32)
+        return x, y
+
+    def placed_bytes_per_chip(tree):
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            try:
+                shp = leaf.sharding.shard_shape(leaf.shape)
+            except Exception:
+                shp = leaf.shape
+            total += int(np.prod(shp, dtype=np.int64)) \
+                * leaf.dtype.itemsize
+        return int(total)
+
+    def timed_loop(step, state, batch):
+        state, loss = step(state, *batch)       # compile + warm
+        bench._sync(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = step(state, *batch)
+        bench._sync(loss)
+        return (time.perf_counter() - t0) / steps, float(loss)
+
+    samples = dp * m * mb                       # global samples/step
+
+    def run_dp():
+        mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
+        state = amp.initialize(None, jax.tree.map(jnp.copy, params),
+                               fused_adam(lr), opt_level="O0")
+
+        def dp_step(state, x, y):
+            def loss_fn(p):
+                out, _ = jax.lax.scan(layer, x, p["stages"])
+                return jnp.mean((out - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, "data"), grads)
+            new_state, _ = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data")
+
+        step = jax.jit(jax.shard_map(
+            dp_step, mesh=mesh,
+            in_specs=(P(), P("data"), P("data")),
+            out_specs=(P(), P()), check_vma=False),
+            donate_argnums=(0,))
+        x, y = batch_of(m)                      # same global samples
+        flat = (x.reshape(-1, hid), y.reshape(-1, hid))
+        compiled = bench._aot_compile(step, state, *flat)
+        state_bytes = placed_bytes_per_chip(
+            (state.params, state.opt_state))
+        dt, loss = timed_loop(step, state, flat)
+        row = {"layout": f"dp={n_dev}",
+               "samples_per_sec_per_chip": round(
+                   samples / dt / n_dev, 2),
+               "step_ms": round(dt * 1e3, 2),
+               "final_loss": round(loss, 5),
+               "state_bytes_per_chip": state_bytes}
+        row.update(bench._memory_fields(compiled))
+        return row
+
+    def run_pipe(mm, want_mem):
+        mesh = Mesh(np.array(jax.devices()[:n_dev]).reshape(dp, pp),
+                    ("data", "pipe"))
+        staged = {"stages": pl.stage_split(params["stages"], pp)}
+        state = amp.initialize(
+            None, jax.tree.map(jnp.copy, staged), fused_adam(lr),
+            opt_level="O0",
+            zero=ZeroConfig(axis="data", axis_size=dp, stage=2))
+        state = pl.stage_local_zero(state, num_stages=pp)
+        state = jax.device_put(
+            state, pl.pipeline_state_shardings(state, mesh=mesh))
+
+        def body(state, mbs, labels):
+            def loss_fn(out, i):
+                yl = jax.lax.dynamic_index_in_dim(labels, i, 0,
+                                                  keepdims=False)
+                return jnp.mean((out - yl) ** 2)
+
+            loss, grads = pl.run_1f1b(stage_fn, loss_fn,
+                                      state.params["stages"], mbs)
+            grads = pl.sync_grad_overflow({"stages": grads})
+            new_state, _ = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data")
+
+        step = pl.wrap_pipeline_step(
+            body, state=state, mesh=mesh,
+            batch_specs=(P("data"), P("data")))
+        batch = batch_of(mm)
+        row = {}
+        if want_mem:
+            compiled = bench._aot_compile(step, state, *batch)
+            row.update(bench._memory_fields(compiled))
+            row["state_bytes_per_chip"] = placed_bytes_per_chip(
+                (state.params, state.opt_state))
+        dt, loss = timed_loop(step, state, batch)
+        row.update({"layout": f"dp={dp} x pipe={pp} zero2",
+                    "microbatches": mm,
+                    "samples_per_sec_per_chip": round(
+                        dp * mm * mb / dt / n_dev, 2),
+                    "step_ms": round(dt * 1e3, 2),
+                    "final_loss": round(loss, 5)})
+        return row
+
+    dp_row = run_dp()
+    pipe_row = run_pipe(m, want_mem=True)
+    pipe_2m = run_pipe(2 * m, want_mem=False)
+
+    # two-m extraction: t(m) = m·τ + overhead, so τ falls out of the
+    # difference and the bubble is the overhead in units of work time
+    t1 = pipe_row["step_ms"]
+    t2 = pipe_2m["step_ms"]
+    tau = (t2 - t1) / m
+    measured_bubble = (round((t1 - m * tau) / (m * tau), 4)
+                       if tau > 0 else None)
+    modeled = pipeline_costs(pp, m, microbatch_tokens=mb,
+                             hidden_size=hid, dtype_bytes=4)
+    band = float(os.environ.get("BENCH_PIPE_BUBBLE_BAND", "0"))
+    within = (abs(measured_bubble - modeled["bubble_fraction"]) <= band
+              if band > 0 and measured_bubble is not None else None)
+
+    _emit({
+        "metric": "pipeline_train_samples_per_sec_per_chip",
+        "value": pipe_row["samples_per_sec_per_chip"],
+        "unit": "samples/sec/chip (CPU-mesh proxy)",
+        "devices": n_dev, "dp": dp, "pipe": pp,
+        "num_layers": layers, "hidden": hid,
+        "num_params": int(n_params),
+        "global_samples_per_step": samples,
+        "rows": {"dp": dp_row, "dp_pipe": pipe_row,
+                 "dp_pipe_2m": pipe_2m},
+        "measured_bubble_fraction": measured_bubble,
+        "modeled": modeled,
+        "bubble_band": band or None,
+        "bubble_within_band": within,
+        "sps_pipe_vs_dp": round(
+            pipe_row["samples_per_sec_per_chip"]
+            / max(dp_row["samples_per_sec_per_chip"], 1e-9), 3),
+        "state_bytes_pipe_vs_dp": round(
+            pipe_row["state_bytes_per_chip"]
+            / max(dp_row["state_bytes_per_chip"], 1), 3),
+        "note": ("ISSUE-20 row: equal chips, equal global batch; the "
+                 "pipe arm's per-chip state is the stage-local "
+                 "ZeRO-2 residency (exact placed-array accounting) "
+                 "and its hbm fields are XLA memory-analysis bytes "
+                 "of the compiled 1F1B step; trajectory agreement is "
+                 "gated by test_loss_trajectory's dp-vs-dp×pipe band "
+                 "leg; on CPU the wall ratio prices compute, not the "
+                 "overlapped ppermute wire — on chip the bubble "
+                 "comparison is the contract (set "
+                 "BENCH_PIPE_BUBBLE_BAND to gate it)"),
+    })
+
+
 LEGS = {
     "resnet50_o1": bench_resnet50_o1,
     "resnet50_syncbn": bench_resnet50_syncbn,
@@ -3560,6 +3801,7 @@ LEGS = {
     "resilience_overhead": bench_resilience_overhead,
     "fleet_serving": bench_fleet_serving,
     "tp_serving": bench_tp_serving,
+    "pipeline_train": bench_pipeline_train,
     "vit_huge_lamb": bench_vit_huge_lamb,
     "long_context": bench_long_context,
     "group_norm": bench_group_norm,
@@ -3567,7 +3809,7 @@ LEGS = {
 
 # legs that must run on the virtual CPU mesh, not the real chip
 _CPU_LEGS = {"gpt2_tp8_full_step", "gpt2_3d_full_step",
-             "mistral7b_tp8_full_step"}
+             "mistral7b_tp8_full_step", "pipeline_train"}
 
 
 # per-leg timeouts: orchestrator legs must outlast the sum of their

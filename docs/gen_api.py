@@ -42,10 +42,11 @@ PAGES = {
     "parallel": ["apex_tpu.parallel.ddp", "apex_tpu.parallel.sync_batchnorm",
                  "apex_tpu.parallel.ring_attention",
                  "apex_tpu.parallel.distributed_optim",
+                 "apex_tpu.parallel.pipeline",
                  "apex_tpu.parallel.launch"],
     "plan": ["apex_tpu.plan", "apex_tpu.plan.costs",
              "apex_tpu.plan.enumerate", "apex_tpu.plan.score",
-             "apex_tpu.plan.emit"],
+             "apex_tpu.plan.emit", "apex_tpu.plan.calibrate"],
     "transformer": ["apex_tpu.transformer.layers",
                     "apex_tpu.transformer.mappings",
                     "apex_tpu.transformer.cross_entropy",

@@ -28,8 +28,20 @@ parameter-count profile of the net (data-parallel only — the planner
 knows nothing about an arbitrary flax module's insides).  An explicit
 ``--zero`` still wins.
 
+``--plan auto --layers N`` (ISSUE 20) swaps the net for a stacked
+residual-MLP ``N`` layers deep so the planner can also enumerate
+**pipeline** degrees; ``--hbm-gb`` sets the per-chip feasibility
+budget.  Tighten it until every dp/ZeRO layout busts and the winner
+is a ``dp × pipe`` layout, which this path adopts end-to-end:
+``stage_split`` by the planned degree → stage-local ZeRO → the
+plan's own ``state_shardings`` placement →
+``parallel.pipeline.wrap_pipeline_step`` running 1F1B over the
+planned mesh with ``plan.microbatches`` microbatches per step.
+
   python examples/simple/distributed.py [--zero 2] [--ckpt-dir /tmp/d]
   python examples/simple/distributed.py --plan auto
+  python examples/simple/distributed.py --plan auto --layers 8 \\
+      --hbm-gb 0.001   # tiny budget: only pipelined layouts fit
 """
 
 from __future__ import annotations
@@ -56,6 +68,125 @@ class Net(nn.Module):
         return nn.Dense(1)(x)
 
 
+def _drive(args, state, train_step, data, mesh):
+    """The shared resilient training loop: both the DP/ZeRO path and
+    the planned-pipeline path end here."""
+    def loop_step(state, batch):
+        state, loss = train_step(state, *batch)
+        return state, {"loss": loss}
+
+    def show(step, row):
+        if step % 10 == 0 or step == args.steps:
+            print(f"step {step:3d}  loss {row['loss']:.5f}")
+
+    from apex_tpu.utils import MetricsWriter
+    loop = ResilientLoop(
+        loop_step,
+        checkpointer=(ResilientCheckpointer(args.ckpt_dir, keep=2)
+                      if args.ckpt_dir else None),
+        checkpoint_every=20,
+        scalars_of=lambda aux: {"loss": aux["loss"]},
+        metrics=MetricsWriter(sink=show))
+    with mesh:
+        state, report = loop.run(state, lambda s: data, args.steps)
+    print(f"steps_run {report.steps_run}  "
+          f"resumed_from {report.resumed_from}  "
+          f"preempted {report.preempted}")
+
+
+def _run_planned_stack(args, ndev):
+    """``--plan auto --layers N``: let the planner pick dp × pipe ×
+    ZeRO for a stacked residual-MLP, then adopt whatever it emits —
+    the same recipe works for a pure-dp winner (``pipe == 1``
+    degenerates cleanly) and a pipelined one."""
+    import dataclasses
+
+    import apex_tpu
+    from apex_tpu.parallel import pipeline as pl
+    from apex_tpu.plan import DEFAULT_HW
+
+    hid = 64
+    r = np.random.default_rng(0)
+    stacked = (
+        jnp.asarray(r.normal(size=(args.layers, hid, hid)) * 0.3,
+                    jnp.float32),
+        jnp.asarray(r.normal(size=(args.layers, hid)) * 0.1,
+                    jnp.float32),
+        jnp.asarray(r.normal(size=(args.layers, hid, hid)) * 0.3,
+                    jnp.float32),
+    )
+    n_params = sum(x.size for x in jax.tree.leaves(stacked))
+    hw = (dataclasses.replace(DEFAULT_HW,
+                              hbm_bytes=args.hbm_gb * 2**30)
+          if args.hbm_gb else None)
+    planned = apex_tpu.plan(
+        apex_tpu.plan.generic_profile(n_params, dtype_bytes=4,
+                                      num_layers=args.layers),
+        devices=ndev, objective="train", hw=hw,
+        microbatches=args.microbatches)
+    lay = planned.layout
+    print(f"plan: auto -> {lay.describe()} "
+          f"({planned.score['value']:.0f} samples/s/chip modeled, "
+          f"{len(planned.alternatives)} alternatives scored)")
+    pipe, m = max(lay.pipe, 1), max(planned.microbatches, 1)
+    if pipe > 1:
+        print(f"pipeline: {pipe} stages (layers "
+              f"{planned.stage_assignment}), {m} microbatches/step, "
+              f"modeled bubble "
+              f"{planned.score.get('bubble_fraction', 0.0):.3f}")
+    else:
+        print("planned layout is not pipelined — tighten --hbm-gb "
+              "to make the dp/ZeRO layouts infeasible")
+
+    # adopt: stage partition -> (stage-local) ZeRO -> planned placement
+    staged = {"stages": pl.stage_split(stacked, pipe)}
+    state = amp.initialize(None, staged,
+                           fused_sgd(0.05, momentum=0.9),
+                           opt_level="O0", zero=planned.zero)
+    if planned.zero is not None:
+        state = pl.stage_local_zero(state, num_stages=pipe)
+    state = jax.device_put(state, planned.state_shardings(state))
+
+    def layer_apply(x, wb):
+        w1, b1, w2 = wb
+        h = jnp.tanh(x @ w1 + b1)
+        return x + h @ w2, None
+
+    def stage_fn(stage_params, x):
+        x, _ = jax.lax.scan(layer_apply, x, stage_params)
+        return x
+
+    def body(state, x, y):
+        def loss_fn(out, i):
+            yl = jax.lax.dynamic_index_in_dim(y, i, 0, keepdims=False)
+            # loss reduction anchored in fp32, like every loss here
+            d = (out - yl).astype(jnp.float32)
+            return jnp.mean(d * d)
+
+        loss, grads = pl.run_1f1b(stage_fn, loss_fn,
+                                  state.params["stages"], x)
+        grads = pl.sync_grad_overflow({"stages": grads})
+        if planned.zero is None:
+            # no ZeRO reduce-scatter to sync the replicas — mean the
+            # grads over data here
+            grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, "data"), grads)
+        new_state, _ = state.apply_gradients(grads=grads)
+        return new_state, jax.lax.pmean(loss, "data")
+
+    train_step = pl.wrap_pipeline_step(
+        body, state=state, mesh=planned.mesh,
+        batch_specs=(planned.data_spec, planned.data_spec))
+
+    mb = 8
+    A = jnp.asarray(r.normal(size=(hid, hid)) * 0.5, jnp.float32)
+    X = jnp.asarray(r.normal(size=(lay.dp * m, mb, hid)), jnp.float32)
+    Y = jnp.tanh(X @ A)
+    sharding = NamedSharding(planned.mesh, planned.data_spec)
+    X, Y = jax.device_put(X, sharding), jax.device_put(Y, sharding)
+    _drive(args, state, train_step, (X, Y), planned.mesh)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ckpt-dir", default=None,
@@ -73,6 +204,18 @@ def main():
                     help="auto = route the ZeRO/wire layout choice "
                          "through apex_tpu.plan() (explicit --zero "
                          "still wins)")
+    ap.add_argument("--layers", type=int, default=0,
+                    help="with --plan auto: use a stacked residual-MLP "
+                         "this many layers deep so the planner can "
+                         "also enumerate pipeline degrees (ISSUE 20)")
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-chip HBM feasibility budget in GB for "
+                         "the planner (tiny fractions are fine for "
+                         "the CPU demo — tighten until only pipelined "
+                         "layouts fit)")
+    ap.add_argument("--microbatches", type=int, default=8,
+                    help="1F1B microbatches per step for planned "
+                         "pipeline layouts")
     args = ap.parse_args()
     if args.zero_int8 and not args.zero:
         ap.error("--zero-int8 needs --zero 1 or 2 (the int8 wire is "
@@ -81,8 +224,11 @@ def main():
     # launcher's env contract) if set; single-host no-op
     from apex_tpu.parallel import init_distributed
     init_distributed()
-    mesh = initialize_mesh(data_parallel_size=-1)
     ndev = len(jax.devices())
+    if args.plan == "auto" and args.layers:
+        _run_planned_stack(args, ndev)
+        return
+    mesh = initialize_mesh(data_parallel_size=-1)
     print(f"mesh: {ndev} device(s) on the 'data' axis")
 
     net = Net()
@@ -169,27 +315,7 @@ def main():
             new_state, _ = state.apply_gradients(grads=grads)
             return new_state, loss
 
-    def loop_step(state, batch):
-        state, loss = train_step(state, *batch)
-        return state, {"loss": loss}
-
-    def show(step, row):
-        if step % 10 == 0 or step == args.steps:
-            print(f"step {step:3d}  loss {row['loss']:.5f}")
-
-    from apex_tpu.utils import MetricsWriter
-    loop = ResilientLoop(
-        loop_step,
-        checkpointer=(ResilientCheckpointer(args.ckpt_dir, keep=2)
-                      if args.ckpt_dir else None),
-        checkpoint_every=20,
-        scalars_of=lambda aux: {"loss": aux["loss"]},
-        metrics=MetricsWriter(sink=show))
-    with mesh:
-        state, report = loop.run(state, lambda s: (X, Y), args.steps)
-    print(f"steps_run {report.steps_run}  "
-          f"resumed_from {report.resumed_from}  "
-          f"preempted {report.preempted}")
+    _drive(args, state, train_step, (X, Y), mesh)
 
 
 if __name__ == "__main__":

@@ -1778,6 +1778,44 @@ class TestUnboundAxisName:
         assert names(found) == [self.RULE]
         assert "'tensor'" in found[0].message
 
+    def test_flagged_collective_permute_binds_its_axis(self):
+        # a permute is not a reduction, but it DOES name an axis —
+        # the 1F1B boundary hop must still point at a bound 'pipe'
+        found = lint("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            mesh = Mesh(np.array(jax.devices()), ("data",))
+
+            def hop(x):
+                return jax.lax.ppermute(
+                    x, "pipe", perm=[(0, 1), (1, 0)])
+
+            g = jax.shard_map(hop, mesh=mesh, in_specs=(P("data"),),
+                              out_specs=P("data"))
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "'pipe'" in found[0].message
+        assert "binds only" in found[0].message
+
+    def test_clean_collective_permute_on_a_pipe_mesh(self):
+        assert lint("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            mesh = Mesh(np.array(jax.devices()).reshape(2, 4),
+                        ("data", "pipe"))
+
+            def hop(x):
+                return jax.lax.ppermute(
+                    x, "pipe", perm=[(0, 1), (1, 0)])
+
+            g = jax.shard_map(hop, mesh=mesh, in_specs=(P("data"),),
+                              out_specs=P("data"))
+        """, self.RULE) == []
+
 
 class TestSpecMeshMismatch:
     """Rule S2: P(...) axes the mesh lacks, or in_specs arity off."""
@@ -1924,6 +1962,45 @@ class TestUnreplicatedOutSpec:
             g = jax.shard_map(
                 broadcast, mesh=mesh, in_specs=(P(), P()),
                 out_specs=P())
+        """, self.RULE) == []
+
+    def test_flagged_permute_does_not_sanitize_divergence(self):
+        # a ppermute MOVES shard-divergent data between shards — the
+        # output is exactly as divergent as the input, so it must not
+        # launder a P() out_spec the way a psum/pmean does
+        found = lint("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            mesh = Mesh(np.array(jax.devices()), ("pipe",))
+
+            def hop(acts):
+                moved = jax.lax.ppermute(
+                    acts, "pipe", perm=[(0, 1), (1, 0)])
+                return moved
+
+            g = jax.shard_map(hop, mesh=mesh,
+                              in_specs=(P("pipe"),), out_specs=P())
+        """, self.RULE)
+        assert names(found) == [self.RULE]
+        assert "DIFFERENT value" in found[0].message
+
+    def test_clean_permute_then_reduction_on_the_return_path(self):
+        assert lint("""
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            mesh = Mesh(np.array(jax.devices()), ("pipe",))
+
+            def hop(acts):
+                moved = jax.lax.ppermute(
+                    acts, "pipe", perm=[(0, 1), (1, 0)])
+                return jax.lax.psum(moved.mean(), "pipe")
+
+            g = jax.shard_map(hop, mesh=mesh,
+                              in_specs=(P("pipe"),), out_specs=P())
         """, self.RULE) == []
 
 

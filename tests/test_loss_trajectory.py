@@ -307,3 +307,118 @@ def test_dp_vs_zero2_loss_trajectory_agreement():
 
     print(context)      # lands in the failure report via pytest -rA
     _assert_trajectories_agree(l_dp, l_zero, names=("DP", "ZeRO-2"))
+
+
+@pytest.mark.slow
+def test_dp_vs_dp_pipe_loss_trajectory_agreement():
+    """ISSUE-20 acceptance leg: pure 8-way DP against the composed
+    dp=2 × pipe=4 1F1B step (stage-local ZeRO-2) at equal chips, same
+    global batch, same optimizer — pipelining reorders the *schedule*
+    of the microbatch forwards/backwards, not the gradient they sum
+    to, so the trajectories must sit inside the same band the other
+    legs use."""
+    import optax
+
+    from apex_tpu.optim import fused_adam as _fa
+    from apex_tpu.parallel import ZeroConfig
+    from apex_tpu.parallel import pipeline as pl
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    steps = 300
+    hid, dp, pp, m, mb = 16, 2, 4, 8, 2      # 32 global samples
+    layers = 4                               # 1 layer per stage
+
+    r = np.random.default_rng(0)
+    init = {"stages": (
+        jnp.asarray(r.normal(size=(layers, hid, hid)) * 0.3,
+                    jnp.float32),
+        jnp.asarray(r.normal(size=(layers, hid)) * 0.1, jnp.float32),
+        jnp.asarray(r.normal(size=(layers, hid, hid)) * 0.3,
+                    jnp.float32),
+    )}
+    # fixed pool of 4 batches, cycled — the signal is memorization
+    # speed, exactly like the GPT legs above
+    n_pool = 4
+    xs = jnp.asarray(r.normal(size=(n_pool, dp * m, mb, hid)),
+                     jnp.float32)
+    ys = jnp.asarray(r.normal(size=(n_pool, dp * m, mb, hid)),
+                     jnp.float32)
+
+    def layer_apply(x, args):
+        w1, b1, w2 = args
+        h = jnp.tanh(x @ w1 + b1)
+        return x + h @ w2, None
+
+    def stage_fn(params, x):
+        x, _ = jax.lax.scan(layer_apply, x, params)
+        return x
+
+    def run_dp():
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]),
+                                 ("data",))
+        tx = _fa(1e-2)
+        params = init
+        opt_state = tx.init(params)
+
+        def dp_step(p, st, x, y):
+            def loss_fn(p):
+                out, _ = jax.lax.scan(layer_apply, x, p["stages"])
+                return jnp.mean((out - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, "data"), grads)
+            loss = jax.lax.pmean(loss, "data")
+            updates, st2 = tx.update(grads, st, p)
+            return optax.apply_updates(p, updates), st2, loss
+
+        step = jax.jit(jax.shard_map(
+            dp_step, mesh=mesh,
+            in_specs=(P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P()), check_vma=False))
+        losses = []
+        for i in range(steps):
+            j = i % n_pool
+            x = xs[j].reshape(-1, hid)
+            y = ys[j].reshape(-1, hid)
+            params, opt_state, loss = step(params, opt_state, x, y)
+            losses.append(float(loss))
+        return np.asarray(losses)
+
+    def run_dp_pipe():
+        from apex_tpu import amp as _amp
+
+        mesh = Mesh(np.array(jax.devices()[:dp * pp]).reshape(dp, pp),
+                    ("data", "pipe"))
+        staged = {"stages": pl.stage_split(init["stages"], pp)}
+        state = _amp.initialize(
+            None, staged, _fa(1e-2), opt_level="O0",
+            zero=ZeroConfig(axis="data", axis_size=dp, stage=2))
+        state = pl.stage_local_zero(state, num_stages=pp)
+        state = jax.device_put(
+            state, pl.pipeline_state_shardings(state, mesh=mesh))
+
+        def body(state, mbs, labels):
+            def loss_fn(out, i):
+                yl = jax.lax.dynamic_index_in_dim(labels, i, 0,
+                                                  keepdims=False)
+                return jnp.mean((out - yl) ** 2)
+
+            loss, grads = pl.run_1f1b(stage_fn, loss_fn,
+                                      state.params["stages"], mbs)
+            grads = pl.sync_grad_overflow({"stages": grads})
+            new_state, _ = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data")
+
+        step = pl.wrap_pipeline_step(
+            body, state=state, mesh=mesh,
+            batch_specs=(P("data"), P("data")))
+        losses = []
+        for i in range(steps):
+            j = i % n_pool
+            state, loss = step(state, xs[j], ys[j])
+            losses.append(float(loss))
+        return np.asarray(losses)
+
+    _assert_trajectories_agree(run_dp(), run_dp_pipe(),
+                               names=("DP", "DPxPIPE"))

@@ -713,3 +713,339 @@ class TestEmbeddingHeadClosure:
                                    rtol=2e-4, atol=1e-6)
         np.testing.assert_allclose(np.asarray(d_head), np.asarray(wdh),
                                    rtol=2e-4, atol=1e-6)
+
+
+# ===========================================================================
+# apex_tpu.parallel.pipeline — the composed dp × pipe (+ ZeRO/TP) train step
+# ===========================================================================
+#
+# The schedule-engine tests above exercise the 1F1B tick table inside
+# its own single-axis driver.  The classes below test the COMPOSITION
+# layer (ISSUE 20): one shard_map over {data, pipe} running the
+# schedule per data replica AND the stage-local ZeRO choreography in
+# the same body, against a single-device full-batch Adam reference.
+
+from jax.sharding import Mesh, NamedSharding  # noqa: E402
+
+from apex_tpu import amp  # noqa: E402
+from apex_tpu.optim import fused_adam  # noqa: E402
+from apex_tpu.parallel import ZeroConfig  # noqa: E402
+from apex_tpu.parallel import pipeline as pl  # noqa: E402
+
+
+def _pl_layer_apply(x, args):
+    w1, b1, w2 = args
+    h = jnp.tanh(x @ w1 + b1)
+    return x + h @ w2, None
+
+
+def _pl_stage_fn(params, x):
+    # params: this stage's (L/p, ...) stacked layer slice
+    x, _ = jax.lax.scan(_pl_layer_apply, x, params)
+    return x
+
+
+def _pl_params(seed, layers):
+    r = np.random.default_rng(seed)
+    return {"stages": (
+        jnp.asarray(r.normal(size=(layers, HID, HID)) * 0.3, jnp.float32),
+        jnp.asarray(r.normal(size=(layers, HID)) * 0.1, jnp.float32),
+        jnp.asarray(r.normal(size=(layers, HID, HID)) * 0.3, jnp.float32),
+    )}
+
+
+def _pl_batch(seed, dp, m):
+    r = np.random.default_rng(seed)
+    x = jnp.asarray(r.normal(size=(dp * m, MB, HID)), jnp.float32)
+    y = jnp.asarray(r.normal(size=(dp * m, MB, HID)), jnp.float32)
+    return x, y
+
+
+def _pl_ref_run(params, x, y, steps, lr=1e-2):
+    """Single-device full-batch Adam: the ground truth the composed
+    dp × pipe step must reproduce (same global batch, same optimizer)."""
+    import optax
+
+    tx = fused_adam(lr)
+    opt = tx.init(params)
+    xs, ys = x.reshape(-1, HID), y.reshape(-1, HID)
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            out, _ = jax.lax.scan(_pl_layer_apply, xs, p["stages"])
+            return jnp.mean((out - ys) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt2 = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt2, loss
+
+    losses = []
+    for _ in range(steps):
+        params, opt, loss = step(params, opt)
+        losses.append(float(loss))
+    return params, losses
+
+
+def _pl_pipe_run(params, x, y, steps, *, dp, pp, lr=1e-2, zero_stage=2):
+    """The composed step: stage_split -> stage_local_zero -> placed ->
+    wrap_pipeline_step loop.  Returns (state, losses, trace_count)."""
+    mesh = Mesh(np.array(jax.devices()[:dp * pp]).reshape(dp, pp),
+                ("data", "pipe"))
+    staged = {"stages": pl.stage_split(params["stages"], pp)}
+    state = amp.initialize(
+        None, staged, fused_adam(lr), opt_level="O0",
+        zero=ZeroConfig(axis="data", axis_size=dp, stage=zero_stage))
+    state = pl.stage_local_zero(state, num_stages=pp)
+    state = jax.device_put(
+        state, pl.pipeline_state_shardings(state, mesh=mesh))
+    traces = [0]
+
+    def body(state, mbs, labels):
+        traces[0] += 1
+
+        def loss_fn(out, i):
+            yl = jax.lax.dynamic_index_in_dim(labels, i, 0,
+                                              keepdims=False)
+            return jnp.mean((out - yl) ** 2)
+
+        loss, grads = pl.run_1f1b(_pl_stage_fn, loss_fn,
+                                  state.params["stages"], mbs)
+        grads = pl.sync_grad_overflow({"stages": grads})
+        new_state, _ = state.apply_gradients(grads=grads)
+        return new_state, jax.lax.pmean(loss, "data")
+
+    step = pl.wrap_pipeline_step(body, state=state, mesh=mesh,
+                                 batch_specs=(P("data"), P("data")))
+    losses = []
+    for _ in range(steps):
+        state, loss = step(state, x, y)
+        losses.append(float(loss))
+    return state, losses, traces[0]
+
+
+class TestBubbleMath:
+    def test_bubble_fraction(self):
+        assert pl.bubble_fraction(4, 8) == pytest.approx(3 / 8)
+        assert pl.bubble_fraction(1, 8) == 0.0  # no pipe, no bubble
+
+    def test_schedule_ticks_and_live(self):
+        # engine tick count m + 2p - 1; live activations flat at p
+        assert pl.schedule_ticks(2, 8) == 11
+        assert pl.schedule_ticks(4, 4) == 11
+        assert pl.live_microbatches(4) == 4
+
+    @pytest.mark.parametrize("fn", [pl.bubble_fraction,
+                                    pl.schedule_ticks])
+    def test_validation(self, fn):
+        with pytest.raises(ValueError):
+            fn(0, 4)
+        with pytest.raises(ValueError):
+            fn(2, 0)
+
+
+class TestStagePartition:
+    def test_split_unsplit_roundtrip(self):
+        tree = {"w": jnp.arange(24.0).reshape(8, 3),
+                "s": jnp.float32(2.0)}
+        staged = pl.stage_split(tree, 4)
+        assert staged["w"].shape == (4, 2, 3)
+        assert staged["s"].shape == ()          # scalars pass through
+        back = pl.stage_unsplit(staged)
+        np.testing.assert_array_equal(np.asarray(back["w"]),
+                                      np.asarray(tree["w"]))
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError, match="stage-balance"):
+            pl.stage_split({"w": jnp.zeros((6, 2))}, 4)
+
+    def test_stage_specs(self):
+        staged = pl.stage_split({"w": jnp.zeros((8, 3)),
+                                 "s": jnp.float32(0)}, 2)
+        specs = pl.stage_specs(staged)
+        assert specs["w"] == P(PIPE_AXIS)
+        assert specs["s"] == P()
+
+
+class TestComposed1F1BStep:
+    """Grads AND optimizer updates of the composed dp × pipe +
+    stage-local ZeRO step match single-device Adam, at m == p (edge:
+    zero steady state) and m > p."""
+
+    @pytest.mark.parametrize("pp,m", [(2, 2), (2, 4), (4, 4), (4, 8)])
+    def test_matches_single_device_adam(self, pp, m):
+        dp = 2
+        params = _pl_params(0, layers=4)        # divisible by both pp
+        x, y = _pl_batch(1, dp, m)
+        ref_params, ref_losses = _pl_ref_run(params, x, y, 3)
+        state, losses, _ = _pl_pipe_run(params, x, y, 3, dp=dp, pp=pp)
+        np.testing.assert_allclose(losses, ref_losses, rtol=0,
+                                   atol=1e-5)
+        got = pl.stage_unsplit(jax.device_get(state.params["stages"]))
+        for g, w in zip(got, ref_params["stages"]):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=0, atol=2e-6)
+
+    def test_zero1_matches_too(self):
+        params = _pl_params(2, layers=4)
+        x, y = _pl_batch(3, 2, 4)
+        _, ref_losses = _pl_ref_run(params, x, y, 2)
+        _, losses, _ = _pl_pipe_run(params, x, y, 2, dp=2, pp=2,
+                                    zero_stage=1)
+        np.testing.assert_allclose(losses, ref_losses, rtol=0,
+                                   atol=1e-5)
+
+    def test_single_trace_across_steps(self):
+        # the declared 1F1B budget: ONE trace covers warmup, steady
+        # state and drain for the whole loop (shape-keyed executable)
+        params = _pl_params(4, layers=4)
+        x, y = _pl_batch(5, 2, 4)
+        _, _, traces = _pl_pipe_run(params, x, y, 5, dp=2, pp=2)
+        assert traces == 1
+
+
+def _partial_manual_supported():
+    """jax 0.4.37's shard_map fallback has no axis_names= (partial
+    manual) — the pipe × tp composition needs it; skip there."""
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("a", "b"))
+    try:
+        f = jax.shard_map(lambda x: x * 2, mesh=mesh, in_specs=(P(),),
+                          out_specs=P(), check_vma=False,
+                          axis_names=frozenset({"a"}))
+        jax.jit(f)(jnp.zeros((2,)))
+        return True
+    except TypeError:
+        return False
+
+
+class TestPipeTensorComposition:
+    def test_pipe_by_tp_matches_reference(self):
+        if not _partial_manual_supported():
+            pytest.skip("partial-manual shard_map (axis_names=) "
+                        "unsupported on this jax version")
+        # data × pipe manual, tensor GSPMD-managed inside the body
+        dp, pp, tp = 2, 2, 2
+        mesh = Mesh(np.array(jax.devices()[:dp * pp * tp])
+                    .reshape(dp, pp, tp), ("data", "pipe", "tensor"))
+        params = _pl_params(6, layers=4)
+        x, y = _pl_batch(7, dp, 4)
+        _, ref_losses = _pl_ref_run(params, x, y, 2)
+        staged = {"stages": pl.stage_split(params["stages"], pp)}
+        state = amp.initialize(
+            None, staged, fused_adam(1e-2), opt_level="O0",
+            zero=ZeroConfig(axis="data", axis_size=dp, stage=2))
+        state = pl.stage_local_zero(state, num_stages=pp)
+        state = jax.device_put(
+            state, pl.pipeline_state_shardings(state, mesh=mesh))
+
+        def body(state, mbs, labels):
+            def loss_fn(out, i):
+                yl = jax.lax.dynamic_index_in_dim(labels, i, 0,
+                                                  keepdims=False)
+                return jnp.mean((out - yl) ** 2)
+
+            loss, grads = pl.run_1f1b(_pl_stage_fn, loss_fn,
+                                      state.params["stages"], mbs)
+            grads = pl.sync_grad_overflow({"stages": grads})
+            new_state, _ = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data")
+
+        step = pl.wrap_pipeline_step(body, state=state, mesh=mesh,
+                                     batch_specs=(P("data"),
+                                                  P("data")))
+        losses = []
+        for _ in range(2):
+            state, loss = step(state, x, y)
+            losses.append(float(loss))
+        np.testing.assert_allclose(losses, ref_losses, rtol=0,
+                                   atol=1e-5)
+
+
+class TestPipelinePlacement:
+    """pipeline_state_specs / pipeline_state_shardings: stage-local
+    masters land P(pipe, data), stage-stacked params P(pipe), plain
+    zero leaves keep the zero_state_specs convention."""
+
+    def _state(self, pp=2, dp=2):
+        params = _pl_params(8, layers=4)
+        staged = {"stages": pl.stage_split(params["stages"], pp),
+                  "head": {"w": jnp.zeros((HID, HID))}}
+        state = amp.initialize(
+            None, staged, fused_adam(1e-2), opt_level="O0",
+            zero=ZeroConfig(axis="data", axis_size=dp, stage=2))
+        return pl.stage_local_zero(state, num_stages=pp,
+                                   staged=("stages",))
+
+    def test_specs(self):
+        state = self._state()
+        specs = pl.pipeline_state_specs(state)
+        assert specs.params["stages"][0] == P(PIPE_AXIS)
+        assert specs.params["head"]["w"] == P()
+        # stage-local (p, n, m_stage) master vs plain (n, m) master
+        assert specs.opt_state.master["stages"][0] == \
+            P(PIPE_AXIS, "data", None)
+        assert specs.opt_state.master["head"]["w"] == P("data", None)
+        assert specs.step == P()
+
+    def test_rejects_non_zero_state(self):
+        state = amp.initialize(None, {"w": jnp.zeros((4,))},
+                               fused_adam(1e-2), opt_level="O0")
+        with pytest.raises(ValueError, match="zero-mode"):
+            pl.pipeline_state_specs(state)
+
+    def test_placement_roundtrip(self):
+        dp, pp = 2, 2
+        mesh = Mesh(np.array(jax.devices()[:dp * pp]).reshape(dp, pp),
+                    ("data", PIPE_AXIS))
+        state = self._state(pp=pp, dp=dp)
+        placed = jax.device_put(
+            state, pl.pipeline_state_shardings(state, mesh=mesh))
+        m = placed.opt_state.master["stages"][0]
+        assert m.sharding.spec == P(PIPE_AXIS, "data", None)
+        # each chip holds ONE stage's ONE data-shard of master rows
+        assert m.sharding.shard_shape(m.shape)[:2] == (1, 1)
+        np.testing.assert_array_equal(
+            np.asarray(m),
+            np.asarray(state.opt_state.master["stages"][0]))
+
+    def test_checkpoint_restores_stage_placement(self, tmp_path):
+        from apex_tpu.resilience import ResilientCheckpointer
+
+        dp, pp = 2, 2
+        mesh = Mesh(np.array(jax.devices()[:dp * pp]).reshape(dp, pp),
+                    ("data", PIPE_AXIS))
+        state = self._state(pp=pp, dp=dp)
+        state = jax.device_put(
+            state, pl.pipeline_state_shardings(state, mesh=mesh))
+        ck = ResilientCheckpointer(str(tmp_path), keep=2)
+        ck.save(1, state, blocking=False)
+        ck.wait()
+        target = self._state(pp=pp, dp=dp)
+        target = jax.device_put(
+            target, pl.pipeline_state_shardings(target, mesh=mesh))
+        step_n, restored = ck.restore_latest(target)
+        assert step_n == 1
+        m = restored.opt_state.master["stages"][0]
+        assert m.sharding.spec == P(PIPE_AXIS, "data", None)
+        np.testing.assert_array_equal(
+            np.asarray(m),
+            np.asarray(state.opt_state.master["stages"][0]))
+
+
+class TestSyncGradOverflow:
+    def _run(self, grads):
+        mesh = Mesh(np.array(jax.devices()[:2]), (PIPE_AXIS,))
+        f = jax.jit(jax.shard_map(
+            lambda g: pl.sync_grad_overflow({"g": g})["g"],
+            mesh=mesh, in_specs=(P(PIPE_AXIS),),
+            out_specs=P(PIPE_AXIS), check_vma=False))
+        return np.asarray(f(grads))
+
+    def test_any_rank_nonfinite_poisons_all(self):
+        g = jnp.ones((2, 4)).at[1, 0].set(jnp.inf)  # rank 1 overflows
+        out = self._run(g)
+        assert not np.isfinite(out).any()       # rank 0 poisoned too
+
+    def test_finite_grads_unchanged(self):
+        g = jnp.arange(8.0).reshape(2, 4)
+        np.testing.assert_array_equal(self._run(g), np.asarray(g))

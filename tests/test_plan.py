@@ -632,3 +632,193 @@ class TestGenericProfile:
                           batch_per_chip=32)
         assert p.layout.dp == N
         assert p.score["hbm_residency"]["activations"] > 0
+
+
+class TestPipelinePlanning:
+    """ISSUE-20: the ``pipe`` axis end-to-end through the planner —
+    enumeration gates, per-stage residency, the bubble + boundary-wire
+    score terms, and the emitted Plan driving an actual 1F1B run."""
+
+    def _prof(self, layers=4):
+        # the tiny residual-MLP stack the pipeline unit tests train:
+        # 4 × (16·16 + 16 + 16·16) = 2112 fp32 params
+        return generic_profile(2112, dtype_bytes=4, num_layers=layers)
+
+    def test_pipe_degrees_enumerate_behind_the_gates(self):
+        pipes = {l.pipe for l in
+                 enumerate_layouts(self._prof(8), 8, "train")}
+        assert pipes == {1, 2, 4, 8}
+        # layer-divisibility gate: 6 layers admit only pipe ∈ {1, 2}
+        assert {l.pipe for l in
+                enumerate_layouts(self._prof(6), 8, "train")} \
+            == {1, 2}
+        # microbatch gate: pipe <= m
+        assert {l.pipe for l in
+                enumerate_layouts(self._prof(8), 8, "train",
+                                  microbatches=2)} == {1, 2}
+        # a profile with no layer count cannot pipeline
+        flat = generic_profile(2112, dtype_bytes=4)
+        assert {l.pipe for l in
+                enumerate_layouts(flat, 8, "train")} == {1}
+
+    def test_per_stage_residency_divides_state(self):
+        prof = self._prof()
+        dp = memory_model(prof, Layout(dp=8), batch_per_chip=4)
+        p4 = memory_model(prof, Layout(dp=2, pipe=4),
+                          batch_per_chip=4, microbatches=4)
+        # each stage holds 1/pipe of params / optimizer / grads
+        assert p4["params"] == dp["params"] / 4
+        assert p4["optimizer_state"] == dp["optimizer_state"] / 4
+        assert p4["gradients"] == dp["gradients"] / 4
+
+    def test_pipeline_costs_match_the_schedule_quantities(self):
+        from apex_tpu.parallel import pipeline as pl
+
+        pc = costs.pipeline_costs(4, 8, microbatch_tokens=128,
+                                  hidden_size=64, dtype_bytes=2)
+        assert pc["bubble_fraction"] == \
+            pytest.approx(pl.bubble_fraction(4, 8))
+        assert pc["schedule_ticks"] == pl.schedule_ticks(4, 8)
+        assert pc["live_microbatches"] == pl.live_microbatches(4)
+        # boundary traffic: 2(p-1) activation hops per microbatch,
+        # none at all without a pipe split
+        payload = 128 * 64 * 2
+        assert pc["boundary_bytes_per_step"] == 2 * 3 * 8 * payload
+        assert costs.pipeline_costs(
+            1, 8, microbatch_tokens=128, hidden_size=64,
+            dtype_bytes=2)["boundary_bytes_per_step"] == 0
+
+    def test_bubble_term_monotone_in_microbatches(self):
+        # more microbatches amortize the (p-1)/m bubble: the score
+        # must strictly improve, and the scorecard carries the
+        # pipeline cost block for inspection
+        prof = self._prof(8)
+        lay = Layout(dp=2, pipe=4)
+        s8 = score_layout(prof, lay, batch_per_chip=4, microbatches=8)
+        s16 = score_layout(prof, lay, batch_per_chip=4,
+                           microbatches=16)
+        assert s8["bubble_fraction"] == pytest.approx(3 / 8)
+        assert s16["bubble_fraction"] == pytest.approx(3 / 16)
+        assert s16["value"] > s8["value"]
+        assert s8["pipeline"]["stages"] == 4
+        assert s8["microbatches"] == 8
+
+    def test_tight_hbm_keeps_only_pipe_layouts_and_plan_trains(self):
+        """The acceptance scenario: at a budget every dp/ZeRO layout
+        busts (the best pipe-free residency is 12672 B here), the
+        planner returns a pipelined layout — and adopting the emitted
+        Plan (mesh, ZeroConfig, stage assignment, placement) actually
+        trains."""
+        import numpy as np
+
+        from apex_tpu.parallel import pipeline as pl
+
+        prof = self._prof()
+        p = apex_tpu.plan(prof, devices=8,
+                          hw=HardwareSpec(hbm_bytes=9000),
+                          batch_per_chip=4, microbatches=4)
+        assert p.layout.pipe > 1
+        assert all(s["layout"].pipe > 1 for s in p.alternatives)
+        assert p.microbatches == 4
+        per = 4 // p.layout.pipe
+        assert p.stage_assignment == [
+            (s * per, (s + 1) * per) for s in range(p.layout.pipe)]
+        assert p.mesh.shape["pipe"] == p.layout.pipe
+        assert p.zero is not None and p.zero.axis_size == p.layout.dp
+
+        # ---- adopt the plan: stage_split by its assignment, its
+        # ZeroConfig, its mesh, its placement — and train
+        hid, layers, mb = 16, 4, 2
+        dp, pp, m = p.layout.dp, p.layout.pipe, p.microbatches
+        r = np.random.default_rng(0)
+        params = {"stages": (
+            jnp.asarray(r.normal(size=(layers, hid, hid)) * 0.3,
+                        jnp.float32),
+            jnp.asarray(r.normal(size=(layers, hid)) * 0.1,
+                        jnp.float32),
+            jnp.asarray(r.normal(size=(layers, hid, hid)) * 0.3,
+                        jnp.float32),
+        )}
+        x = jnp.asarray(r.normal(size=(dp * m, mb, hid)), jnp.float32)
+        y = jnp.asarray(r.normal(size=(dp * m, mb, hid)), jnp.float32)
+
+        staged = {"stages": pl.stage_split(params["stages"], pp)}
+        state = amp.initialize(None, staged, fused_adam(1e-2),
+                               opt_level="O0", zero=p.zero)
+        state = pl.stage_local_zero(state, num_stages=pp)
+        state = jax.device_put(state, p.state_shardings(state))
+
+        def layer_apply(xx, args):
+            w1, b1, w2 = args
+            return xx + jnp.tanh(xx @ w1 + b1) @ w2, None
+
+        def stage_fn(sp, xx):
+            xx, _ = jax.lax.scan(layer_apply, xx, sp)
+            return xx
+
+        def body(state, mbs, labels):
+            def loss_fn(out, i):
+                yl = jax.lax.dynamic_index_in_dim(labels, i, 0,
+                                                  keepdims=False)
+                return jnp.mean((out - yl) ** 2)
+
+            loss, grads = pl.run_1f1b(stage_fn, loss_fn,
+                                      state.params["stages"], mbs)
+            grads = pl.sync_grad_overflow({"stages": grads})
+            new_state, _ = state.apply_gradients(grads=grads)
+            return new_state, jax.lax.pmean(loss, "data")
+
+        # the emitted mesh carries every library axis (degenerate
+        # ones at size 1) — wrap_pipeline_step folds those into the
+        # manual set, so this exercises the planner-mesh path
+        step = pl.wrap_pipeline_step(
+            body, state=state, mesh=p.mesh,
+            batch_specs=(p.data_spec, p.data_spec))
+        losses = []
+        for _ in range(5):
+            state, loss = step(state, x, y)
+            losses.append(float(loss))
+        assert np.all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+
+class TestCalibrate:
+    """plan.calibrate — the measured HardwareSpec (ISSUE-20
+    satellite): off-accelerator identity, forced sweeps, the
+    ``hardware=`` alias."""
+
+    def test_cpu_host_returns_defaults_untouched(self):
+        from apex_tpu.plan import DEFAULT_HW, calibrate
+
+        # a host-emulated "peak" would poison the feasibility gate:
+        # off-accelerator the bench-constant defaults come back AS-IS
+        assert calibrate() is DEFAULT_HW
+
+    def test_forced_sweeps_measure_this_host(self):
+        from apex_tpu.plan import DEFAULT_HW, calibrate
+
+        hw = calibrate(force=True, matmul_n=64, copy_mbytes=1,
+                       psum_mbytes=1, iters=1)
+        assert hw is not DEFAULT_HW
+        assert hw.peak_tflops > 0
+        assert hw.peak_hbm_gbs > 0
+        assert hw.peak_ici_gbs > 0      # 8 virtual devices: a wire
+        # ... and they are measurements, not the bench constants
+        assert hw.peak_tflops != DEFAULT_HW.peak_tflops
+
+    def test_single_device_keeps_the_ici_default(self):
+        from apex_tpu.plan import DEFAULT_HW, calibrate
+
+        hw = calibrate(jax.devices()[:1], force=True, matmul_n=32,
+                       copy_mbytes=1, iters=1)
+        assert hw.peak_ici_gbs == DEFAULT_HW.peak_ici_gbs
+
+    def test_hardware_alias_plans_and_double_spec_errors(self):
+        from apex_tpu.plan import DEFAULT_HW, calibrate
+
+        prof = generic_profile(2112, dtype_bytes=4, num_layers=4)
+        p = apex_tpu.plan(prof, devices=8, hardware=calibrate())
+        assert p.score["value"] > 0
+        with pytest.raises(ValueError, match="not both"):
+            apex_tpu.plan(prof, devices=8, hw=DEFAULT_HW,
+                          hardware=DEFAULT_HW)

@@ -72,11 +72,20 @@ _MARK_RE = re.compile(
 _COLLECTIVES = {
     "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
     "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "collective_permute": 1,
     "axis_index": 0, "axis_size": 0,
 }
 #: collectives that REDUCE across shards (clear rule-3 divergence)
 _REDUCING = {"psum", "pmean", "pmax", "pmin", "psum_scatter",
              "all_gather", "all_to_all"}
+#: collectives that PERMUTE across shards: they bind an axis name
+#: (rule S1 checks it, via _COLLECTIVES above) but they are NOT
+#: reductions — every shard still holds a DIFFERENT (neighbor's)
+#: value afterward, so they must not sanitize per-shard divergence
+#: in the rule-S3 lattice (the 1F1B pipeline moves activations with
+#: exactly this op; a misclassification would blind S3 inside every
+#: pipeline body)
+_PERMUTING = {"ppermute", "pshuffle", "collective_permute"}
 
 _FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
 _FuncNode = _FuncDef + (ast.Lambda,)
@@ -756,6 +765,14 @@ class _Analysis:
             root = d.split(".", 1)[0]
             operands = (list(expr.args)
                         + [k.value for k in expr.keywords])
+            if la in _PERMUTING:
+                # a permute moves shard-divergent data between shards
+                # — the output is exactly as divergent as the input,
+                # whichever spelling (bare `ppermute(...)` included:
+                # without this branch it would fall through to the
+                # unknown-callee sanitizer below)
+                return any(self._divergent_expr(a, tainted)
+                           for a in operands)
             if root in ("jnp", "lax", "np", "jax", "numpy"):
                 return any(self._divergent_expr(a, tainted)
                            for a in operands)

@@ -88,6 +88,12 @@ SUBSET = [
     # the emitted placements commit onto real devices — cheap to run,
     # catches a planner/engine key drift on the hardware that matters
     "tests/test_plan.py",
+    # pipeline parallelism (ISSUE 20): the 1F1B schedule's ppermute
+    # ring, the stage-local ZeRO placement and the single-trace budget
+    # must hold against REAL ICI neighbor links and per-chip HBM — the
+    # virtual CPU mesh proves the schedule math, not the wire or the
+    # per-stage residency
+    "tests/test_pipeline.py",
     "tests/test_chaos.py",
 ]
 
